@@ -1,0 +1,67 @@
+"""deequ_tpu — a TPU-native "unit tests for data" framework.
+
+A brand-new data-quality framework with the capabilities of Deequ
+(reference: ``jmscraig/deequ``, a Scala/Spark library — see SURVEY.md):
+declarative checks evaluated against data-quality metrics, single-pass
+scan-shared analyzer execution, mergeable incremental state, column
+profiling, constraint suggestion, a persisted metrics repository, and
+metric-series anomaly detection.
+
+The execution engine is idiomatic JAX/XLA: analyzer states are fixed-shape
+pytree commutative monoids, updates are vectorized masked reductions fused
+by XLA into a single pass over device-resident column batches, merges are
+collectives (psum / elementwise max / gather+recompress) over a
+``jax.sharding.Mesh``. Upper layers (checks, constraints, repository,
+anomaly detection, suggestion rules) are pure Python and engine-agnostic —
+mirroring the reference's layering where everything above AnalysisRunner
+never touches a DataFrame (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import os
+
+# int64/float64 support: states carry exact row counts (int64) and
+# high-precision accumulators. On TPU, f64 is emulated — the engine's hot
+# accumulation dtype is configurable (see deequ_tpu.config); finalization
+# epilogues are tiny so f64 there is free.
+if os.environ.get("DEEQU_TPU_NO_X64", "0") != "1":
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+from deequ_tpu.metrics import (  # noqa: E402
+    DoubleMetric,
+    Entity,
+    HistogramMetric,
+    KLLMetric,
+    Metric,
+)
+from deequ_tpu.data import Dataset  # noqa: E402
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
+from deequ_tpu.verification import (  # noqa: E402
+    VerificationResult,
+    VerificationSuite,
+)
+from deequ_tpu.analyzers.runner import (  # noqa: E402
+    AnalysisRunner,
+    AnalyzerContext,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AnalysisRunner",
+    "AnalyzerContext",
+    "Check",
+    "CheckLevel",
+    "CheckStatus",
+    "Dataset",
+    "DoubleMetric",
+    "Entity",
+    "HistogramMetric",
+    "KLLMetric",
+    "Metric",
+    "VerificationResult",
+    "VerificationSuite",
+]

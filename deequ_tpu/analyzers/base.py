@@ -1,0 +1,272 @@
+"""Analyzer framework: states are commutative monoids, metrics are values.
+
+Reference contract (``src/main/scala/com/amazon/deequ/analyzers/Analyzer.scala``,
+SURVEY.md §2.2): an analyzer is (compute state from data, compute metric
+from state, preconditions); states implement ``sum`` (a commutative monoid
+merge) — the whole incremental/distributed story hangs on that.
+
+deequ_tpu expresses each scan-shareable analyzer as a :class:`ScanOps`
+triple over fixed-shape pytrees:
+
+- ``init()``                  — monoid identity (host numpy pytree)
+- ``update(state, batch)``    — traced, vectorized masked reduction over a
+                                device batch; XLA fuses all analyzers'
+                                updates into a single pass (the TPU
+                                equivalent of the reference's one
+                                ``df.agg(...)`` scan, SURVEY.md §3.1 ★#1)
+- ``merge(a, b)``             — traced monoid merge; also the collective
+                                used across the device mesh and across
+                                persisted incremental states
+
+Finalization (state → metric) is a tiny host-side epilogue, and failures
+(missing column, empty state) become failure *metrics*, never user-facing
+exceptions (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, Schema
+from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
+from deequ_tpu.utils.trylike import Failure
+
+
+# --------------------------------------------------------------------------
+# Failure model (reference: analyzers/runners/MetricCalculationException.scala)
+# --------------------------------------------------------------------------
+
+
+class MetricCalculationException(Exception):
+    """Base for per-analyzer failures embedded into failure metrics."""
+
+
+class NoSuchColumnException(MetricCalculationException):
+    pass
+
+
+class WrongColumnTypeException(MetricCalculationException):
+    pass
+
+
+class NoColumnsSpecifiedException(MetricCalculationException):
+    pass
+
+
+class NumberOfSpecifiedColumnsException(MetricCalculationException):
+    pass
+
+
+class IllegalAnalyzerParameterException(MetricCalculationException):
+    pass
+
+
+class EmptyStateException(MetricCalculationException):
+    pass
+
+
+class MetricCalculationRuntimeException(MetricCalculationException):
+    pass
+
+
+def wrap_if_necessary(exc: BaseException) -> MetricCalculationException:
+    if isinstance(exc, MetricCalculationException):
+        return exc
+    return MetricCalculationRuntimeException(repr(exc))
+
+
+# --------------------------------------------------------------------------
+# Preconditions (reference: analyzers/Preconditions object)
+# --------------------------------------------------------------------------
+
+Precondition = Callable[[Schema], None]
+
+
+def has_column(column: str) -> Precondition:
+    def check(schema: Schema) -> None:
+        if not schema.has_column(column):
+            raise NoSuchColumnException(
+                f"Input data does not include column {column}!"
+            )
+
+    return check
+
+
+def is_numeric(column: str) -> Precondition:
+    def check(schema: Schema) -> None:
+        if not schema.kind_of(column).is_numeric:
+            raise WrongColumnTypeException(
+                f"Expected type of column {column} to be numeric, but found "
+                f"{schema.kind_of(column).value} instead!"
+            )
+
+    return check
+
+
+def is_string(column: str) -> Precondition:
+    def check(schema: Schema) -> None:
+        if schema.kind_of(column) != Kind.STRING:
+            raise WrongColumnTypeException(
+                f"Expected type of column {column} to be String, but found "
+                f"{schema.kind_of(column).value} instead!"
+            )
+
+    return check
+
+
+def is_not_nested(column: str) -> Precondition:
+    def check(schema: Schema) -> None:
+        if schema.kind_of(column) == Kind.UNKNOWN:
+            raise WrongColumnTypeException(
+                f"Unsupported nested/unknown type in column {column}!"
+            )
+
+    return check
+
+
+def at_least_one(columns: Sequence[str]) -> Precondition:
+    def check(schema: Schema) -> None:
+        if len(columns) == 0:
+            raise NoColumnsSpecifiedException(
+                "At least one column needs to be specified!"
+            )
+
+    return check
+
+
+def exactly_n_columns(columns: Sequence[str], n: int) -> Precondition:
+    def check(schema: Schema) -> None:
+        if len(columns) != n:
+            raise NumberOfSpecifiedColumnsException(
+                f"Exactly {n} columns needed, got {len(columns)}"
+            )
+
+    return check
+
+
+# --------------------------------------------------------------------------
+# Scan ops
+# --------------------------------------------------------------------------
+
+StateTree = Any  # pytree of arrays (numpy host-side, jax inside jit)
+Batch = Dict[str, Any]
+
+
+@dataclass
+class ScanOps:
+    """The (identity, update, merge) triple for one analyzer, compiled
+    against a concrete dataset (closures hold dictionaries / compiled
+    predicates)."""
+
+    init: Callable[[], StateTree]
+    update: Callable[[StateTree, Batch], StateTree]
+    merge: Callable[[StateTree, StateTree], StateTree]
+
+
+# --------------------------------------------------------------------------
+# Analyzer base classes
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Analyzer:
+    """Base analyzer. Frozen dataclass => hashable, dedupable (the runner
+    dedups analyzers and uses them as context-map keys, SURVEY.md §2.4)."""
+
+    # -- identity -------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    @property
+    def instance(self) -> str:
+        raise NotImplementedError
+
+    # -- contract -------------------------------------------------------
+
+    def preconditions(self) -> List[Precondition]:
+        return []
+
+    def compute_metric_from_state(self, state: Optional[StateTree]) -> Metric:
+        """Host-side finalize; ``state=None`` means no rows contributed."""
+        raise NotImplementedError
+
+    def to_failure_metric(self, exc: BaseException) -> Metric:
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Failure(wrap_if_necessary(exc))
+        )
+
+    # -- convenience ----------------------------------------------------
+
+    def calculate(
+        self,
+        data: Dataset,
+        aggregate_with=None,
+        save_states_with=None,
+        engine=None,
+    ) -> Metric:
+        """Compute just this analyzer (delegates to the runner so scan
+        sharing / precondition semantics are identical)."""
+        from deequ_tpu.analyzers.runner import AnalysisRunner
+
+        context = AnalysisRunner.do_analysis_run(
+            data,
+            [self],
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+            engine=engine,
+        )
+        return context.metric(self)  # type: ignore[return-value]
+
+
+@dataclass(frozen=True)
+class ScanShareableAnalyzer(Analyzer):
+    """An analyzer whose state updates fuse into the shared single pass."""
+
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        raise NotImplementedError
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class GroupingAnalyzer(Analyzer):
+    """An analyzer over value frequencies; the runner computes one
+    frequency table per distinct (grouping columns, filter) and shares it
+    (reference: GroupingAnalyzers.scala / FrequencyBasedAnalyzer)."""
+
+    def grouping_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def filter_condition(self) -> Optional[str]:
+        return None
+
+    def compute_metric_from_frequencies(self, frequencies) -> Metric:
+        raise NotImplementedError
+
+    def preconditions(self) -> List[Precondition]:
+        cols = self.grouping_columns()
+        checks: List[Precondition] = [at_least_one(cols)]
+        checks.extend(has_column(c) for c in cols)
+        checks.extend(is_not_nested(c) for c in cols)
+        return checks
+
+
+def merged_where_clause(where: Optional[str]) -> str:
+    return where if where else "(no filter)"
+
+
+def filter_suffix(where: Optional[str]) -> Tuple:
+    """Include the filter in analyzer identity so differently-filtered
+    analyzers don't collide in the context map."""
+    return (where,) if where else ()

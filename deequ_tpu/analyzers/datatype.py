@@ -1,0 +1,170 @@
+"""DataType inference analyzer.
+
+Reference: ``analyzers/DataType.scala`` + the ``StatefulDataType``
+Catalyst aggregate (SURVEY.md §2.2, §2.3): per-value classification into
+{Unknown(null), Fractional, Integral, Boolean, String} buckets, counts
+packed into a vector whose merge is elementwise sum.
+
+TPU design (SURVEY.md §2.3 table): the regex classification runs
+host-side ONCE over the column *dictionary* (vectorized, small), giving a
+code -> bucket lookup table; the device pass is a gather + one-hot
+count — a 5-counter psum across the mesh. Numeric/boolean columns
+classify from the schema directly (every non-null value already has the
+column's type).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    Precondition,
+    ScanOps,
+    ScanShareableAnalyzer,
+    has_column,
+)
+from deequ_tpu.analyzers.basic import _compile_where, _row_mask
+from deequ_tpu.analyzers.states import DataTypeHistogram
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind
+from deequ_tpu.metrics.distribution import (
+    Distribution,
+    DistributionValue,
+    HistogramMetric,
+)
+from deequ_tpu.metrics.metric import Entity, Metric
+from deequ_tpu.utils.trylike import Success
+
+# Classification regexes (reference: StatefulDataType's patterns)
+_INTEGRAL_RE = re.compile(r"^[-+]?\d+$")
+_FRACTIONAL_RE = re.compile(r"^[-+]?(\d+\.\d*|\.\d+|\d+)([eE][-+]?\d+)?$")
+_BOOLEAN_RE = re.compile(r"^(true|false)$", re.IGNORECASE)
+
+_BUCKET_NAMES = ("Unknown", "Fractional", "Integral", "Boolean", "String")
+
+
+def classify_string(value: str) -> int:
+    if _BOOLEAN_RE.match(value):
+        return DataTypeHistogram.BOOLEAN
+    if _INTEGRAL_RE.match(value):
+        return DataTypeHistogram.INTEGRAL
+    if _FRACTIONAL_RE.match(value):
+        return DataTypeHistogram.FRACTIONAL
+    return DataTypeHistogram.STRING
+
+
+@dataclass(frozen=True)
+class DataType(ScanShareableAnalyzer):
+    """Inferred-type histogram of a column (reference: DataType.scala)."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Precondition]:
+        return [has_column(self.column)]
+
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        _, reqs = _compile_where(self.where, dataset)
+        kind = dataset.schema.kind_of(self.column)
+        col_req = ColumnRequest(
+            self.column, "codes" if kind == Kind.STRING else "mask"
+        )
+        return [col_req, ColumnRequest(self.column, "mask")] + reqs
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        where_fn, _ = _compile_where(self.where, dataset)
+        col = self.column
+        kind = dataset.schema.kind_of(col)
+
+        if kind == Kind.STRING:
+            dictionary = dataset.dictionary(col)
+            lut = np.zeros(max(len(dictionary), 1), dtype=np.int32)
+            for i, value in enumerate(dictionary):
+                lut[i] = (
+                    DataTypeHistogram.NULL
+                    if value is None
+                    else classify_string(str(value))
+                )
+            lut_dev = jnp.asarray(lut)
+
+            def update(state: DataTypeHistogram, batch) -> DataTypeHistogram:
+                rows = _row_mask(batch, where_fn)
+                valid = batch[f"{col}::mask"] & rows
+                codes = batch[f"{col}::codes"]
+                bucket = lut_dev[jnp.clip(codes, 0, lut_dev.shape[0] - 1)]
+                bucket = jnp.where(valid, bucket, DataTypeHistogram.NULL)
+                bucket = jnp.where(rows, bucket, 5)  # padding -> reserved
+                counts = jnp.bincount(bucket, length=7)[:6]
+                new = state.counts + counts.astype(jnp.int64)
+                new = new.at[5].set(0)
+                return DataTypeHistogram(new)
+
+        else:
+            static_bucket = {
+                Kind.INTEGRAL: DataTypeHistogram.INTEGRAL,
+                Kind.FRACTIONAL: DataTypeHistogram.FRACTIONAL,
+                Kind.BOOLEAN: DataTypeHistogram.BOOLEAN,
+            }.get(kind, DataTypeHistogram.STRING)
+
+            def update(state: DataTypeHistogram, batch) -> DataTypeHistogram:
+                rows = _row_mask(batch, where_fn)
+                valid = batch[f"{col}::mask"] & rows
+                n_valid = jnp.sum(valid, dtype=jnp.int64)
+                n_null = jnp.sum(rows & ~valid, dtype=jnp.int64)
+                counts = state.counts
+                counts = counts.at[static_bucket].add(n_valid)
+                counts = counts.at[DataTypeHistogram.NULL].add(n_null)
+                return DataTypeHistogram(counts)
+
+        return ScanOps(
+            DataTypeHistogram.identity, update, DataTypeHistogram.merge
+        )
+
+    def compute_metric_from_state(self, state) -> Metric:
+        if state is None:
+            state = DataTypeHistogram.identity()
+        counts = np.asarray(state.counts)[:5]
+        total = int(counts.sum())
+        values = {
+            name: DistributionValue(
+                int(c), (int(c) / total) if total else 0.0
+            )
+            for name, c in zip(_BUCKET_NAMES, counts)
+        }
+        dist = Distribution(values, number_of_bins=5)
+        return HistogramMetric(
+            Entity.COLUMN, "DataType", self.instance, Success(dist)
+        )
+
+
+def inferred_kind(metric: HistogramMetric) -> Kind:
+    """Decide a concrete type from the histogram, the way the reference's
+    profiler promotes string columns (SURVEY.md §3.3 pass 1->2): any
+    String => String; any Fractional => Fractional (integrals embed);
+    else Integral / Boolean / Unknown."""
+    dist = metric.value.get()
+    non_null = {
+        k: v.absolute for k, v in dist.values.items() if k != "Unknown"
+    }
+    total = sum(non_null.values())
+    if total == 0:
+        return Kind.UNKNOWN
+    if non_null.get("String", 0) > 0:
+        return Kind.STRING
+    if non_null.get("Fractional", 0) > 0:
+        if non_null.get("Boolean", 0) > 0:
+            return Kind.STRING
+        return Kind.FRACTIONAL
+    if non_null.get("Boolean", 0) > 0:
+        if non_null.get("Integral", 0) > 0:
+            return Kind.STRING
+        return Kind.BOOLEAN
+    return Kind.INTEGRAL

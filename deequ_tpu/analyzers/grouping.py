@@ -1,0 +1,489 @@
+"""Grouping (frequency-based) analyzers: CountDistinct, Distinctness,
+Uniqueness, UniqueValueRatio, Entropy, MutualInformation, Histogram.
+
+Reference: ``src/main/scala/com/amazon/deequ/analyzers/GroupingAnalyzers.scala``
+and one file per analyzer (SURVEY.md §2.2): analyzers over value
+frequencies share one ``groupBy().count()`` per distinct (grouping
+columns, filter) — the shared state is ``FrequenciesAndNumRows``.
+
+TPU design (SURVEY.md §7 hard part #1): the TPU has no shuffle. Grouping
+columns are dictionary-encoded host-side by Arrow's C++ kernels (exact,
+vectorized); the device pass is a masked scatter-add of joint codes into
+a dense count vector — one fused pass per frequency group, batched the
+same way as the scan analyzers. Cross-shard/cross-dataset merges operate
+on (key, count) pairs host-side, exactly like the reference merges
+frequency DataFrames with unionByName + groupBy.sum (SURVEY.md §3.2).
+For joint-key spaces too large for a dense vector, computation falls
+back to Arrow's multithreaded host group_by.
+
+Row semantics follow the reference: rows where ALL grouping columns are
+null are excluded (``atLeastOneNonNullGroupingColumn``); Histogram runs
+its own frequency pass that keeps nulls as a ``NullValue`` bin.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from deequ_tpu.analyzers.base import (
+    Analyzer,
+    EmptyStateException,
+    GroupingAnalyzer,
+    Precondition,
+    has_column,
+)
+from deequ_tpu.data.table import ROW_MASK, ColumnRequest, Dataset
+from deequ_tpu.engine.scan import AnalysisEngine
+from deequ_tpu.metrics.distribution import HistogramMetric
+from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
+from deequ_tpu.sql.predicate import compile_predicate
+
+NULL_VALUE = "NullValue"  # reference: Histogram's bin name for nulls
+MAX_DENSE_JOINT = 1 << 24  # dense device count-vector cap
+
+
+# --------------------------------------------------------------------------
+# Shared state
+# --------------------------------------------------------------------------
+
+
+class FrequenciesAndNumRows:
+    """(value combination -> count) plus the number of contributing rows.
+
+    Host-side object (the reference's equivalent holds a DataFrame):
+    ``keys`` is an object ndarray of shape (K, n_cols) whose entries are
+    Python values (None encodes SQL NULL), ``counts`` an int64 (K,).
+    Merge is a host dictionary union with summed counts — the incremental
+    path across datasets/days (SURVEY.md §3.2).
+    """
+
+    def __init__(
+        self,
+        columns: Tuple[str, ...],
+        keys: np.ndarray,
+        counts: np.ndarray,
+        num_rows: int,
+    ):
+        self.columns = tuple(columns)
+        self.keys = keys
+        self.counts = np.asarray(counts, dtype=np.int64)
+        self.num_rows = int(num_rows)
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.counts)
+
+    @staticmethod
+    def merge(
+        a: "FrequenciesAndNumRows", b: "FrequenciesAndNumRows"
+    ) -> "FrequenciesAndNumRows":
+        if a.columns != b.columns:
+            raise ValueError(
+                f"cannot merge frequencies over {a.columns} with {b.columns}"
+            )
+        combined: Dict[Tuple, int] = {}
+        for keys, counts in ((a.keys, a.counts), (b.keys, b.counts)):
+            for row, count in zip(keys, counts):
+                key = tuple(row)
+                combined[key] = combined.get(key, 0) + int(count)
+        if combined:
+            key_arr = np.empty((len(combined), len(a.columns)), dtype=object)
+            for i, key in enumerate(combined):
+                key_arr[i, :] = key
+            count_arr = np.fromiter(
+                combined.values(), dtype=np.int64, count=len(combined)
+            )
+        else:
+            key_arr = np.empty((0, len(a.columns)), dtype=object)
+            count_arr = np.zeros(0, dtype=np.int64)
+        return FrequenciesAndNumRows(
+            a.columns, key_arr, count_arr, a.num_rows + b.num_rows
+        )
+
+
+# --------------------------------------------------------------------------
+# Frequency computation (the "groupBy" pass)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FrequencyPlan:
+    """Identity of one shared frequency pass."""
+
+    columns: Tuple[str, ...]
+    where: Optional[str]
+    include_nulls: bool  # Histogram keeps nulls as their own bin
+
+
+def compute_frequencies(
+    dataset: Dataset,
+    plan: FrequencyPlan,
+    engine: Optional[AnalysisEngine] = None,
+) -> FrequenciesAndNumRows:
+    engine = engine or AnalysisEngine()
+    columns = list(plan.columns)
+    dictionaries = [dataset.dictionary(c) for c in columns]
+    sizes = [len(d) + 1 for d in dictionaries]  # +1: the null slot
+    joint = 1
+    for s in sizes:
+        joint *= s
+    if joint <= MAX_DENSE_JOINT:
+        return _device_frequencies(dataset, plan, dictionaries, sizes, engine)
+    return _arrow_frequencies(dataset, plan)
+
+
+def _where_mask_full(dataset: Dataset, where: Optional[str]) -> Optional[np.ndarray]:
+    """Evaluate a where-filter over the whole table (used by the host
+    fallback); returns bool ndarray or None."""
+    if where is None:
+        return None
+    pred = compile_predicate(where, dataset)
+    batch = {r.key: dataset.materialize(r) for r in pred.requests}
+    batch[ROW_MASK] = np.ones(dataset.num_rows, dtype=bool)
+    return np.asarray(jax.device_get(pred.complies(batch))).astype(bool)
+
+
+def _device_frequencies(
+    dataset: Dataset,
+    plan: FrequencyPlan,
+    dictionaries: List[np.ndarray],
+    sizes: List[int],
+    engine: AnalysisEngine,
+) -> FrequenciesAndNumRows:
+    columns = list(plan.columns)
+    where_fn = None
+    requests = [ColumnRequest(c, "codes") for c in columns] + [
+        ColumnRequest(c, "mask") for c in columns
+    ]
+    if plan.where is not None:
+        pred = compile_predicate(plan.where, dataset)
+        where_fn = pred.complies
+        requests += list(pred.requests)
+
+    joint = 1
+    for s in sizes:
+        joint *= s
+
+    def init():
+        return (
+            np.zeros(joint, dtype=np.int64),
+            np.int64(0),
+        )
+
+    def update(state, batch):
+        counts, num_rows = state
+        rows = batch[ROW_MASK]
+        if where_fn is not None:
+            rows = rows & where_fn(batch)
+        if plan.include_nulls:
+            keep = rows
+        else:
+            any_non_null = jnp.zeros_like(rows)
+            for c in columns:
+                any_non_null = any_non_null | batch[f"{c}::mask"]
+            keep = rows & any_non_null
+        code = jnp.zeros_like(batch[f"{columns[0]}::codes"])
+        for c, size in zip(columns, sizes):
+            shifted = batch[f"{c}::codes"] + 1  # null (-1) -> slot 0
+            code = code * size + shifted
+        # masked scatter-add; rejected rows go to an overflow slot
+        code = jnp.where(keep, code, joint)
+        counts = counts + jnp.bincount(
+            code, length=joint + 1
+        )[:joint].astype(jnp.int64)
+        return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
+
+    class _FreqAnalyzer:
+        """Adapter so the frequency pass rides the shared scan engine."""
+
+        def device_requests(self, ds):
+            return requests
+
+    from deequ_tpu.analyzers.base import ScanOps
+
+    ops = ScanOps(init, update, lambda a, b: (a[0] + b[0], a[1] + b[1]))
+    (counts, num_rows), = [
+        s
+        for s in engine.run_scan(dataset, [(_FreqAnalyzer(), ops)])  # type: ignore[list-item]
+    ]
+    counts = np.asarray(counts)
+    num_rows = int(num_rows)
+
+    observed = np.nonzero(counts)[0]
+    key_arr = np.empty((len(observed), len(columns)), dtype=object)
+    remaining = observed.copy()
+    for j in range(len(columns) - 1, -1, -1):
+        slot = remaining % sizes[j]
+        remaining = remaining // sizes[j]
+        dictionary = dictionaries[j]
+        for i, s in enumerate(slot):
+            key_arr[i, j] = None if s == 0 else dictionary[s - 1]
+    return FrequenciesAndNumRows(
+        tuple(columns), key_arr, counts[observed], num_rows
+    )
+
+
+def _arrow_frequencies(
+    dataset: Dataset, plan: FrequencyPlan
+) -> FrequenciesAndNumRows:
+    """Host fallback for huge joint key spaces: Arrow's multithreaded
+    C++ group_by (the 'spill' strategy of SURVEY.md §7 hard part #1)."""
+    columns = list(plan.columns)
+    table = dataset.table.select(columns)
+    mask = _where_mask_full(dataset, plan.where)
+    if not plan.include_nulls:
+        non_null = np.zeros(dataset.num_rows, dtype=bool)
+        for c in columns:
+            non_null |= dataset.materialize(ColumnRequest(c, "mask"))
+        mask = non_null if mask is None else (mask & non_null)
+    if mask is not None:
+        table = table.filter(pa.array(mask))
+    grouped = table.group_by(columns).aggregate([([], "count_all")])
+    counts = grouped.column("count_all").to_numpy(zero_copy_only=False)
+    key_arr = np.empty((len(counts), len(columns)), dtype=object)
+    for j, c in enumerate(columns):
+        key_arr[:, j] = np.asarray(grouped.column(c).to_pylist(), dtype=object)
+    return FrequenciesAndNumRows(
+        tuple(columns), key_arr, counts.astype(np.int64), int(table.num_rows)
+    )
+
+
+def run_grouping_analyzers(
+    dataset: Dataset,
+    analyzers: Sequence[GroupingAnalyzer],
+    engine: Optional[AnalysisEngine],
+    aggregate_with,
+    save_states_with,
+) -> Dict[Analyzer, Metric]:
+    """Group analyzers by their frequency plan; ONE pass per plan, shared
+    by every analyzer in the group (SURVEY.md §2.4 step 5)."""
+    metrics: Dict[Analyzer, Metric] = {}
+    by_plan: Dict[FrequencyPlan, List[GroupingAnalyzer]] = {}
+    for analyzer in analyzers:
+        plan = FrequencyPlan(
+            tuple(analyzer.grouping_columns()),
+            analyzer.filter_condition,
+            getattr(analyzer, "include_nulls", False),
+        )
+        by_plan.setdefault(plan, []).append(analyzer)
+
+    for plan, group in by_plan.items():
+        try:
+            frequencies = compute_frequencies(dataset, plan, engine)
+        except Exception as exc:  # noqa: BLE001
+            for analyzer in group:
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
+            continue
+        for analyzer in group:
+            try:
+                state = frequencies
+                if aggregate_with is not None:
+                    prior = aggregate_with.load(analyzer)
+                    if prior is not None:
+                        state = FrequenciesAndNumRows.merge(state, prior)
+                if save_states_with is not None:
+                    save_states_with.persist(analyzer, state)
+                metrics[analyzer] = analyzer.compute_metric_from_state(state)
+            except Exception as exc:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
+    return metrics
+
+
+# --------------------------------------------------------------------------
+# Concrete grouping analyzers
+# --------------------------------------------------------------------------
+
+
+def _normalize_columns(columns: Union[str, Sequence[str]]) -> Tuple[str, ...]:
+    if isinstance(columns, str):
+        return (columns,)
+    return tuple(columns)
+
+
+@dataclass(frozen=True)
+class _FrequencyAnalyzer(GroupingAnalyzer):
+    columns: Tuple[str, ...] = ()
+    where: Optional[str] = None
+
+    def __init__(
+        self, columns: Union[str, Sequence[str]], where: Optional[str] = None
+    ):
+        object.__setattr__(self, "columns", _normalize_columns(columns))
+        object.__setattr__(self, "where", where)
+
+    def grouping_columns(self) -> List[str]:
+        return list(self.columns)
+
+    @property
+    def filter_condition(self) -> Optional[str]:
+        return self.where
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN if len(self.columns) == 1 else Entity.MULTICOLUMN
+
+    @property
+    def instance(self) -> str:
+        return ",".join(self.columns)
+
+    def compute_metric_from_state(self, state) -> Metric:
+        if state is None or state.num_rows == 0:
+            return self.to_failure_metric(
+                EmptyStateException(
+                    f"Empty state for analyzer {self.name}."
+                )
+            )
+        return DoubleMetric.success(
+            self.entity, self.name, self.instance, self._value(state)
+        )
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        raise NotImplementedError
+
+
+class CountDistinct(_FrequencyAnalyzer):
+    """Exact distinct count (reference: analyzers/CountDistinct.scala)."""
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        return float(state.num_groups)
+
+
+class Distinctness(_FrequencyAnalyzer):
+    """#distinct / #rows (reference: analyzers/Distinctness.scala)."""
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        return state.num_groups / state.num_rows
+
+
+class Uniqueness(_FrequencyAnalyzer):
+    """Fraction of values occurring exactly once (reference:
+    analyzers/Uniqueness.scala)."""
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        return float(np.sum(state.counts == 1)) / state.num_rows
+
+
+class UniqueValueRatio(_FrequencyAnalyzer):
+    """#unique / #distinct (reference: analyzers/UniqueValueRatio.scala)."""
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        return float(np.sum(state.counts == 1)) / state.num_groups
+
+
+class Entropy(_FrequencyAnalyzer):
+    """Shannon entropy of the value distribution (reference:
+    analyzers/Entropy.scala); computed over non-null groups."""
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        non_null = np.array(
+            [all(v is not None for v in row) for row in state.keys], dtype=bool
+        )
+        counts = state.counts[non_null].astype(np.float64)
+        total = counts.sum()
+        if total == 0:
+            raise EmptyStateException("Entropy over empty distribution.")
+        p = counts / total
+        return float(-(p * np.log(p)).sum())
+
+
+class MutualInformation(_FrequencyAnalyzer):
+    """Mutual information of two columns (reference:
+    analyzers/MutualInformation.scala) — derived from the joint frequency
+    table; rows with any null in the pair are excluded."""
+
+    def preconditions(self) -> List[Precondition]:
+        from deequ_tpu.analyzers.base import exactly_n_columns
+
+        return [exactly_n_columns(self.columns, 2)] + super().preconditions()
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.MULTICOLUMN
+
+    def _value(self, state: FrequenciesAndNumRows) -> float:
+        keep = np.array(
+            [all(v is not None for v in row) for row in state.keys], dtype=bool
+        )
+        keys = state.keys[keep]
+        counts = state.counts[keep].astype(np.float64)
+        total = counts.sum()
+        if total == 0:
+            raise EmptyStateException("MutualInformation over empty state.")
+        p_joint = counts / total
+        left: Dict[object, float] = {}
+        right: Dict[object, float] = {}
+        for row, p in zip(keys, p_joint):
+            left[row[0]] = left.get(row[0], 0.0) + p
+            right[row[1]] = right.get(row[1], 0.0) + p
+        mi = 0.0
+        for row, p in zip(keys, p_joint):
+            mi += p * math.log(p / (left[row[0]] * right[row[1]]))
+        return float(mi)
+
+
+@dataclass(frozen=True)
+class Histogram(GroupingAnalyzer):
+    """Full value distribution, null values kept as a ``NullValue`` bin,
+    detail capped at ``max_detail_bins`` (reference:
+    analyzers/Histogram.scala — runs its own groupBy; SURVEY.md §2.2)."""
+
+    column: str = ""
+    max_detail_bins: int = 1000
+    where: Optional[str] = None
+
+    def __init__(
+        self,
+        column: str,
+        max_detail_bins: int = 1000,
+        where: Optional[str] = None,
+    ):
+        object.__setattr__(self, "column", column)
+        object.__setattr__(self, "max_detail_bins", max_detail_bins)
+        object.__setattr__(self, "where", where)
+
+    include_nulls = True
+
+    def grouping_columns(self) -> List[str]:
+        return [self.column]
+
+    @property
+    def filter_condition(self) -> Optional[str]:
+        return self.where
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Precondition]:
+        return [has_column(self.column)]
+
+    def compute_metric_from_state(self, state) -> Metric:
+        if state is None:
+            return self.to_failure_metric(
+                EmptyStateException("Empty state for analyzer Histogram.")
+            )
+        order = np.argsort(-state.counts, kind="stable")
+        top = order[: self.max_detail_bins]
+        counts: Dict[str, int] = {}
+        for i in top:
+            value = state.keys[i, 0]
+            label = NULL_VALUE if value is None else str(value)
+            counts[label] = int(state.counts[i])
+        metric = HistogramMetric.from_counts(
+            "Histogram", self.instance, counts, state.num_rows
+        )
+        # number_of_bins reflects the FULL distinct count even when the
+        # detail is capped (reference behavior)
+        from deequ_tpu.metrics.distribution import Distribution
+
+        full = Distribution(metric.value.get().values, state.num_groups)
+        return HistogramMetric(
+            Entity.COLUMN, "Histogram", self.instance, type(metric.value)(full)
+        )

@@ -1,0 +1,385 @@
+"""AnalysisRunner: THE engine entry point and pass planner.
+
+Reference: ``src/main/scala/com/amazon/deequ/analyzers/runners/
+AnalysisRunner.scala`` (SURVEY.md §2.4, §3.1): dedup analyzers, reuse
+repository metrics, check preconditions (failures become failure metrics
+immediately), fuse all scan-shareable analyzers into one pass, run one
+frequency computation per distinct (grouping columns, filter) shared by
+all grouping analyzers over it, assemble an ``AnalyzerContext``, and
+optionally aggregate/persist states (the incremental path,
+``runOnAggregatedStates``, SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.analyzers.base import (
+    Analyzer,
+    GroupingAnalyzer,
+    MetricCalculationException,
+    ScanShareableAnalyzer,
+    wrap_if_necessary,
+)
+from deequ_tpu.data.table import Dataset, Schema
+from deequ_tpu.engine.scan import AnalysisEngine
+from deequ_tpu.metrics.metric import Metric
+
+
+# --------------------------------------------------------------------------
+# AnalyzerContext
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class AnalyzerContext:
+    """Map analyzer -> metric (reference: AnalyzerContext.scala)."""
+
+    metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
+
+    @staticmethod
+    def empty() -> "AnalyzerContext":
+        return AnalyzerContext({})
+
+    def all_metrics(self) -> List[Metric]:
+        return list(self.metric_map.values())
+
+    def metric(self, analyzer: Analyzer) -> Optional[Metric]:
+        return self.metric_map.get(analyzer)
+
+    def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
+        merged = dict(self.metric_map)
+        merged.update(other.metric_map)
+        return AnalyzerContext(merged)
+
+    def success_metrics_as_records(
+        self, for_analyzers: Optional[Sequence[Analyzer]] = None
+    ) -> List[Dict[str, Any]]:
+        """Flat records (entity, instance, name, value) for successful
+        metrics — the equivalent of successMetricsAsDataFrame."""
+        records = []
+        for analyzer, metric in self.metric_map.items():
+            if for_analyzers and analyzer not in for_analyzers:
+                continue
+            for flat in metric.flatten():
+                if flat.value.is_success:
+                    records.append(
+                        {
+                            "entity": flat.entity.value,
+                            "instance": flat.instance,
+                            "name": flat.name,
+                            "value": flat.value.get(),
+                        }
+                    )
+        return records
+
+    def success_metrics_as_json(
+        self, for_analyzers: Optional[Sequence[Analyzer]] = None
+    ) -> str:
+        return json.dumps(
+            self.success_metrics_as_records(for_analyzers), indent=2
+        )
+
+    def success_metrics_as_dataframe(self, for_analyzers=None):
+        import pandas as pd
+
+        return pd.DataFrame(
+            self.success_metrics_as_records(for_analyzers),
+            columns=["entity", "instance", "name", "value"],
+        )
+
+
+# --------------------------------------------------------------------------
+# AnalysisRunner
+# --------------------------------------------------------------------------
+
+
+def _dedup(analyzers: Sequence[Analyzer]) -> List[Analyzer]:
+    seen = set()
+    out = []
+    for a in analyzers:
+        if a not in seen:
+            seen.add(a)
+            out.append(a)
+    return out
+
+
+class AnalysisRunner:
+    """Static facade mirroring the reference's AnalysisRunner object."""
+
+    @staticmethod
+    def on_data(data: Dataset) -> "AnalysisRunBuilder":
+        return AnalysisRunBuilder(data)
+
+    @staticmethod
+    def do_analysis_run(
+        data: Dataset,
+        analyzers: Sequence[Analyzer],
+        aggregate_with=None,
+        save_states_with=None,
+        engine: Optional[AnalysisEngine] = None,
+        metrics_repository=None,
+        reuse_existing_results_for_key=None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key=None,
+    ) -> AnalyzerContext:
+        analyzers = _dedup(analyzers)
+        if not analyzers:
+            return AnalyzerContext.empty()
+        engine = engine or AnalysisEngine()
+
+        # 1) reuse existing metrics from the repository (SURVEY.md §2.4 (1))
+        reused = AnalyzerContext.empty()
+        if metrics_repository is not None and reuse_existing_results_for_key is not None:
+            existing = metrics_repository.load_by_key(
+                reuse_existing_results_for_key
+            )
+            if existing is not None:
+                reusable = {
+                    a: m
+                    for a, m in existing.analyzer_context.metric_map.items()
+                    if a in analyzers
+                }
+                reused = AnalyzerContext(reusable)
+            if fail_if_results_missing and len(reused.metric_map) < len(analyzers):
+                missing = [a for a in analyzers if a not in reused.metric_map]
+                raise RuntimeError(
+                    "Could not find all necessary results in the "
+                    f"MetricsRepository, missing: {missing}"
+                )
+        remaining = [a for a in analyzers if a not in reused.metric_map]
+
+        # 2) preconditions against the schema -> immediate failure metrics
+        passed: List[Analyzer] = []
+        failures: Dict[Analyzer, Metric] = {}
+        for analyzer in remaining:
+            exc = _check_preconditions(analyzer, data.schema)
+            if exc is not None:
+                failures[analyzer] = analyzer.to_failure_metric(exc)
+            else:
+                passed.append(analyzer)
+
+        # 3) partition into scan-shareable / grouping / direct
+        scan_shareable = [
+            a for a in passed if isinstance(a, ScanShareableAnalyzer)
+        ]
+        grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
+        others = [
+            a
+            for a in passed
+            if not isinstance(a, (ScanShareableAnalyzer, GroupingAnalyzer))
+        ]
+
+        metrics: Dict[Analyzer, Metric] = dict(failures)
+
+        # 4) ONE fused scan for every scan-shareable analyzer
+        metrics.update(
+            _run_scanning_analyzers(
+                data, scan_shareable, engine, aggregate_with, save_states_with
+            )
+        )
+
+        # 5) one frequency computation per (grouping columns, filter)
+        if grouping:
+            from deequ_tpu.analyzers.grouping import run_grouping_analyzers
+
+            metrics.update(
+                run_grouping_analyzers(
+                    data, grouping, engine, aggregate_with, save_states_with
+                )
+            )
+
+        # 6) schema-only analyzers
+        for analyzer in others:
+            try:
+                metrics[analyzer] = analyzer.compute_directly(data)  # type: ignore[attr-defined]
+            except Exception as exc:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
+
+        context = reused + AnalyzerContext(metrics)
+
+        # 7) optionally persist to the metrics repository
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            from deequ_tpu.repository.base import AnalysisResult
+
+            current = metrics_repository.load_by_key(
+                save_or_append_results_with_key
+            )
+            combined = (
+                current.analyzer_context + context
+                if current is not None
+                else context
+            )
+            metrics_repository.save(
+                AnalysisResult(save_or_append_results_with_key, combined)
+            )
+
+        return context
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema: Schema,
+        analyzers: Sequence[Analyzer],
+        state_loaders: Sequence[Any],
+        save_states_with=None,
+    ) -> AnalyzerContext:
+        """Incremental path: merge persisted states monoidally and compute
+        metrics WITHOUT touching data (SURVEY.md §3.2)."""
+        analyzers = _dedup(analyzers)
+        metrics: Dict[Analyzer, Metric] = {}
+        for analyzer in analyzers:
+            exc = _check_preconditions(analyzer, schema)
+            if exc is not None:
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
+                continue
+            states = [
+                s
+                for loader in state_loaders
+                for s in [loader.load(analyzer)]
+                if s is not None
+            ]
+            try:
+                if not states:
+                    metrics[analyzer] = analyzer.compute_metric_from_state(None)
+                    continue
+                merged = states[0]
+                merge = _merge_fn_for(merged)
+                for s in states[1:]:
+                    merged = merge(merged, s)
+                if save_states_with is not None:
+                    save_states_with.persist(analyzer, merged)
+                metrics[analyzer] = analyzer.compute_metric_from_state(merged)
+            except Exception as exc:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
+        return AnalyzerContext(metrics)
+
+
+def _merge_fn_for(state: Any):
+    """States carry their own dataset-independent merge (monoid)."""
+    merge = getattr(type(state), "merge", None)
+    if merge is None:
+        raise MetricCalculationException(
+            f"state type {type(state).__name__} has no merge"
+        )
+    return merge
+
+
+def _check_preconditions(
+    analyzer: Analyzer, schema: Schema
+) -> Optional[BaseException]:
+    try:
+        for precondition in analyzer.preconditions():
+            precondition(schema)
+        return None
+    except Exception as exc:  # noqa: BLE001
+        return wrap_if_necessary(exc)
+
+
+def _run_scanning_analyzers(
+    data: Dataset,
+    analyzers: List[ScanShareableAnalyzer],
+    engine: AnalysisEngine,
+    aggregate_with,
+    save_states_with,
+) -> Dict[Analyzer, Metric]:
+    """Plan + run the fused scan; per-analyzer plan failures (bad
+    predicate, unknown column inside an expression) degrade to failure
+    metrics without aborting the shared pass."""
+    metrics: Dict[Analyzer, Metric] = {}
+    planned: List[Tuple[ScanShareableAnalyzer, Any]] = []
+    for analyzer in analyzers:
+        try:
+            planned.append((analyzer, analyzer.make_ops(data)))
+        except Exception as exc:  # noqa: BLE001
+            metrics[analyzer] = analyzer.to_failure_metric(exc)
+    if not planned:
+        return metrics
+
+    try:
+        states = engine.run_scan(data, planned)
+    except Exception as exc:  # noqa: BLE001
+        wrapped = wrap_if_necessary(exc)
+        for analyzer, _ in planned:
+            metrics[analyzer] = analyzer.to_failure_metric(wrapped)
+        return metrics
+
+    for (analyzer, ops), state in zip(planned, states):
+        try:
+            if aggregate_with is not None:
+                prior = aggregate_with.load(analyzer)
+                if prior is not None:
+                    state = ops.merge(state, prior)
+            if save_states_with is not None:
+                save_states_with.persist(analyzer, state)
+            metrics[analyzer] = analyzer.compute_metric_from_state(state)
+        except Exception as exc:  # noqa: BLE001
+            metrics[analyzer] = analyzer.to_failure_metric(exc)
+    return metrics
+
+
+# --------------------------------------------------------------------------
+# Builder (reference: AnalysisRunBuilder.scala)
+# --------------------------------------------------------------------------
+
+
+class AnalysisRunBuilder:
+    def __init__(self, data: Dataset):
+        self._data = data
+        self._analyzers: List[Analyzer] = []
+        self._engine: Optional[AnalysisEngine] = None
+        self._aggregate_with = None
+        self._save_states_with = None
+        self._repository = None
+        self._reuse_key = None
+        self._fail_if_results_missing = False
+        self._save_key = None
+
+    def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
+        self._analyzers.append(analyzer)
+        return self
+
+    def add_analyzers(self, analyzers: Sequence[Analyzer]) -> "AnalysisRunBuilder":
+        self._analyzers.extend(analyzers)
+        return self
+
+    def with_engine(self, engine: AnalysisEngine) -> "AnalysisRunBuilder":
+        self._engine = engine
+        return self
+
+    def aggregate_with(self, state_loader) -> "AnalysisRunBuilder":
+        self._aggregate_with = state_loader
+        return self
+
+    def save_states_with(self, state_persister) -> "AnalysisRunBuilder":
+        self._save_states_with = state_persister
+        return self
+
+    def use_repository(self, repository) -> "AnalysisRunBuilder":
+        self._repository = repository
+        return self
+
+    def reuse_existing_results_for_key(
+        self, key, fail_if_results_missing: bool = False
+    ) -> "AnalysisRunBuilder":
+        self._reuse_key = key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, key) -> "AnalysisRunBuilder":
+        self._save_key = key
+        return self
+
+    def run(self) -> AnalyzerContext:
+        return AnalysisRunner.do_analysis_run(
+            self._data,
+            self._analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            engine=self._engine,
+            metrics_repository=self._repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+        )

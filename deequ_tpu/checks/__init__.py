@@ -1,0 +1,19 @@
+from deequ_tpu.checks.check import (
+    Check,
+    CheckLevel,
+    CheckResult,
+    CheckStatus,
+    CheckWithLastConstraintFilterable,
+    ConstrainableDataTypes,
+    is_one,
+)
+
+__all__ = [
+    "Check",
+    "CheckLevel",
+    "CheckResult",
+    "CheckStatus",
+    "CheckWithLastConstraintFilterable",
+    "ConstrainableDataTypes",
+    "is_one",
+]

@@ -1,0 +1,17 @@
+from deequ_tpu.constraints.constraint import (
+    AnalysisBasedConstraint,
+    Constraint,
+    ConstraintDecorator,
+    ConstraintResult,
+    ConstraintStatus,
+    NamedConstraint,
+)
+
+__all__ = [
+    "AnalysisBasedConstraint",
+    "Constraint",
+    "ConstraintDecorator",
+    "ConstraintResult",
+    "ConstraintStatus",
+    "NamedConstraint",
+]

@@ -1,0 +1,17 @@
+from deequ_tpu.data.table import (
+    ROW_MASK,
+    ColumnRequest,
+    Dataset,
+    Field,
+    Kind,
+    Schema,
+)
+
+__all__ = [
+    "ColumnRequest",
+    "Dataset",
+    "Field",
+    "Kind",
+    "ROW_MASK",
+    "Schema",
+]

@@ -1,0 +1,310 @@
+"""Columnar dataset: Arrow ingest and device-batch materialization.
+
+This is deequ_tpu's L0/L1 replacement for Spark DataFrames (SURVEY.md §1,
+§7 stage 0). A :class:`Dataset` wraps a ``pyarrow.Table`` and materializes
+*device representations* of columns on demand:
+
+- ``values``   — numeric payload (nulls zero-filled; see mask)
+- ``mask``     — validity bitmap as bool (True = non-null), AND row mask
+- ``codes``    — dictionary codes (int32) for string/categorical columns,
+                 with the dictionary kept host-side (strings never reach
+                 the TPU — SURVEY.md §7 hard part #3)
+- ``lengths``  — utf8 lengths for string columns (MinLength/MaxLength)
+
+Batches are fixed-size and zero-padded (padding rows carry
+``__row_mask__ == False``) so that every batch has the same static shape
+and the fused analyzer scan compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+ROW_MASK = "__row_mask__"
+
+
+class Kind(enum.Enum):
+    """Logical column kinds (maps Arrow types to analyzer preconditions)."""
+
+    INTEGRAL = "Integral"
+    FRACTIONAL = "Fractional"
+    BOOLEAN = "Boolean"
+    STRING = "String"
+    TIMESTAMP = "Timestamp"
+    UNKNOWN = "Unknown"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (Kind.INTEGRAL, Kind.FRACTIONAL, Kind.BOOLEAN)
+
+
+def _kind_of(arrow_type: pa.DataType) -> Kind:
+    if pa.types.is_boolean(arrow_type):
+        return Kind.BOOLEAN
+    if pa.types.is_integer(arrow_type):
+        return Kind.INTEGRAL
+    if pa.types.is_floating(arrow_type) or pa.types.is_decimal(arrow_type):
+        return Kind.FRACTIONAL
+    if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
+        return Kind.STRING
+    if pa.types.is_dictionary(arrow_type):
+        return _kind_of(arrow_type.value_type)
+    if pa.types.is_timestamp(arrow_type) or pa.types.is_date(arrow_type):
+        return Kind.TIMESTAMP
+    return Kind.UNKNOWN
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    kind: Kind
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: Tuple[Field, ...]
+
+    @property
+    def column_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def has_column(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    def kind_of(self, name: str) -> Kind:
+        for f in self.fields:
+            if f.name == name:
+                return f.kind
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+
+@dataclass(frozen=True)
+class ColumnRequest:
+    """A device representation request: (column, repr)."""
+
+    column: str
+    repr: str  # "values" | "mask" | "codes" | "lengths"
+
+    @property
+    def key(self) -> str:
+        return f"{self.column}::{self.repr}"
+
+
+class Dataset:
+    """In-memory columnar dataset over a ``pyarrow.Table``.
+
+    Construction helpers accept Arrow tables, pandas DataFrames, or plain
+    dicts of Python/numpy sequences. All device materializations are cached
+    per (column, repr) as contiguous numpy arrays; batches are views plus a
+    single zero-pad for the tail.
+    """
+
+    def __init__(self, table: pa.Table):
+        self._table = table.combine_chunks()
+        self._schema = Schema(
+            tuple(
+                Field(name, _kind_of(typ))
+                for name, typ in zip(table.schema.names, table.schema.types)
+            )
+        )
+        self._materialized: Dict[str, np.ndarray] = {}
+        self._dictionaries: Dict[str, np.ndarray] = {}
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def from_arrow(table: pa.Table) -> "Dataset":
+        return Dataset(table)
+
+    @staticmethod
+    def from_pandas(df) -> "Dataset":
+        return Dataset(pa.Table.from_pandas(df, preserve_index=False))
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence]) -> "Dataset":
+        return Dataset(pa.table(data))
+
+    # -- metadata -------------------------------------------------------
+
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    @property
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    @property
+    def num_columns(self) -> int:
+        return self._table.num_columns
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def filter_rows(self, mask: np.ndarray) -> "Dataset":
+        """Row subset (host-side); used by train/test splits and schema
+        validation, not by the metric engine."""
+        return Dataset(self._table.filter(pa.array(mask)))
+
+    def select(self, columns: Sequence[str]) -> "Dataset":
+        return Dataset(self._table.select(list(columns)))
+
+    # -- dictionaries ---------------------------------------------------
+
+    def dictionary(self, column: str) -> np.ndarray:
+        """Host-side dictionary (unique values) for a column; codes index
+        into this. Built once per column via Arrow's C++ kernels."""
+        if column not in self._dictionaries:
+            self._materialize_codes(column)
+        return self._dictionaries[column]
+
+    def _materialize_codes(self, column: str) -> None:
+        arr = self._table.column(column)
+        if pa.types.is_dictionary(arr.type):
+            dict_arr = arr.combine_chunks()
+        else:
+            dict_arr = pc.dictionary_encode(arr).combine_chunks()
+        if isinstance(dict_arr, pa.ChunkedArray):
+            dict_arr = dict_arr.combine_chunks()
+        indices = dict_arr.indices
+        codes = (
+            pc.fill_null(indices, pa.scalar(-1, indices.type))
+            .to_numpy(zero_copy_only=False)
+            .astype(np.int32)
+        )
+        self._materialized[f"{column}::codes"] = np.ascontiguousarray(codes)
+        dictionary = dict_arr.dictionary
+        self._dictionaries[column] = np.asarray(
+            dictionary.to_pylist(), dtype=object
+        )
+
+    # -- device materialization ----------------------------------------
+
+    def materialize(self, req: ColumnRequest) -> np.ndarray:
+        key = req.key
+        if key in self._materialized:
+            return self._materialized[key]
+        col = self._table.column(req.column)
+        kind = self._schema.kind_of(req.column)
+        if req.repr == "mask":
+            if col.null_count == 0:
+                out = np.ones(len(col), dtype=bool)
+            else:
+                out = ~col.is_null().combine_chunks().to_numpy(
+                    zero_copy_only=False
+                )
+            out = np.ascontiguousarray(out.astype(bool))
+        elif req.repr == "values":
+            if kind == Kind.STRING:
+                raise TypeError(
+                    f"column '{req.column}' is a string column; request "
+                    "'codes' or 'lengths' instead of 'values'"
+                )
+            filled = col
+            if kind == Kind.TIMESTAMP:
+                filled = pc.cast(col, pa.int64())
+                if col.null_count:
+                    filled = pc.fill_null(filled, pa.scalar(0, pa.int64()))
+            elif col.null_count:
+                zero = pa.scalar(False) if kind == Kind.BOOLEAN else pa.scalar(
+                    0, type=col.type
+                )
+                filled = pc.fill_null(col, zero)
+            out = filled.combine_chunks().to_numpy(zero_copy_only=False)
+            if kind == Kind.BOOLEAN:
+                out = out.astype(np.int32)
+            elif out.dtype == np.float16:
+                out = out.astype(np.float32)
+            elif out.dtype.kind not in "iuf":
+                out = out.astype(np.float64)
+            out = np.ascontiguousarray(out)
+        elif req.repr == "codes":
+            self._materialize_codes(req.column)
+            return self._materialized[key]
+        elif req.repr == "lengths":
+            lengths = pc.fill_null(
+                pc.utf8_length(col), pa.scalar(0, pa.int32())
+            )
+            out = np.ascontiguousarray(
+                lengths.combine_chunks()
+                .to_numpy(zero_copy_only=False)
+                .astype(np.int32)
+            )
+        else:
+            raise ValueError(f"unknown column repr: {req.repr!r}")
+        self._materialized[key] = out
+        return out
+
+    # -- batching -------------------------------------------------------
+
+    def device_batches(
+        self,
+        requests: Sequence[ColumnRequest],
+        batch_size: Optional[int] = None,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield fixed-size batches (host numpy; the engine device_puts).
+
+        Every batch has identical shapes: the tail batch is zero-padded
+        and padding rows have ``__row_mask__ == False``; per-column masks
+        are pre-ANDed with the row mask so updates need a single mask.
+        """
+        n = self.num_rows
+        if batch_size is None:
+            batch_size = n if n > 0 else 1
+        batch_size = max(1, batch_size)
+        # dedup requests; always provide masks for requested columns
+        keys: Dict[str, ColumnRequest] = {}
+        for r in requests:
+            keys.setdefault(r.key, r)
+            mask_req = ColumnRequest(r.column, "mask")
+            keys.setdefault(mask_req.key, mask_req)
+        full: Dict[str, np.ndarray] = {
+            k: self.materialize(r) for k, r in keys.items()
+        }
+        if n == 0:
+            batch = {
+                k: np.zeros((batch_size,), dtype=v.dtype)
+                for k, v in full.items()
+            }
+            batch[ROW_MASK] = np.zeros((batch_size,), dtype=bool)
+            yield batch
+            return
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            width = stop - start
+            pad = batch_size - width
+            batch = {}
+            for k, v in full.items():
+                sl = v[start:stop]
+                if pad:
+                    sl = np.concatenate(
+                        [sl, np.zeros((pad,), dtype=v.dtype)]
+                    )
+                batch[k] = sl
+            row_mask = np.ones((batch_size,), dtype=bool)
+            if pad:
+                row_mask[width:] = False
+            batch[ROW_MASK] = row_mask
+            if pad:
+                for k in list(batch.keys()):
+                    if k.endswith("::mask"):
+                        batch[k] = batch[k] & row_mask
+            yield batch
+
+    def num_batches(self, batch_size: Optional[int] = None) -> int:
+        n = self.num_rows
+        if n == 0:
+            return 1
+        if batch_size is None:
+            return 1
+        return -(-n // batch_size)

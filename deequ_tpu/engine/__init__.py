@@ -1,0 +1,3 @@
+from deequ_tpu.engine.scan import AnalysisEngine, monoid_all_reduce
+
+__all__ = ["AnalysisEngine", "monoid_all_reduce"]

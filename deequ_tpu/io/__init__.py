@@ -1,0 +1,13 @@
+from deequ_tpu.io.state_provider import (
+    FileSystemStateProvider,
+    InMemoryStateProvider,
+    StateLoader,
+    StatePersister,
+)
+
+__all__ = [
+    "FileSystemStateProvider",
+    "InMemoryStateProvider",
+    "StateLoader",
+    "StatePersister",
+]

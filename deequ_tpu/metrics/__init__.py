@@ -1,0 +1,25 @@
+from deequ_tpu.metrics.metric import (
+    DoubleMetric,
+    Entity,
+    KeyedDoubleMetric,
+    Metric,
+)
+from deequ_tpu.metrics.distribution import (
+    Distribution,
+    DistributionValue,
+    HistogramMetric,
+)
+from deequ_tpu.metrics.kll import BucketDistribution, BucketValue, KLLMetric
+
+__all__ = [
+    "BucketDistribution",
+    "BucketValue",
+    "Distribution",
+    "DistributionValue",
+    "DoubleMetric",
+    "Entity",
+    "HistogramMetric",
+    "KeyedDoubleMetric",
+    "KLLMetric",
+    "Metric",
+]

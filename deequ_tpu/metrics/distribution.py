@@ -1,0 +1,86 @@
+"""Histogram metric: value -> (absolute count, ratio) distribution.
+
+Reference: ``src/main/scala/com/amazon/deequ/metrics/Distribution.scala``
+(SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Sequence
+
+from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
+from deequ_tpu.utils.trylike import Success
+
+
+@dataclass(frozen=True)
+class DistributionValue:
+    absolute: int
+    ratio: float
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """Value distribution over (up to ``max_detail_bins``) observed values."""
+
+    values: Dict[str, DistributionValue]
+    number_of_bins: int
+
+    def __getitem__(self, key: str) -> DistributionValue:
+        return self.values[key]
+
+    def argmax(self) -> str:
+        return max(self.values.items(), key=lambda kv: kv[1].absolute)[0]
+
+
+@dataclass(frozen=True)
+class HistogramMetric(Metric[Distribution]):
+    """Full value distribution of a column (reference: HistogramMetric)."""
+
+    def flatten(self) -> Sequence[DoubleMetric]:
+        if self.value.is_failure:
+            return (
+                DoubleMetric(
+                    self.entity, f"{self.name}.bins", self.instance, self.value
+                ),
+            )
+        dist = self.value.get()
+        out = [
+            DoubleMetric(
+                self.entity,
+                f"{self.name}.bins",
+                self.instance,
+                Success(float(dist.number_of_bins)),
+            )
+        ]
+        for key, dv in dist.values.items():
+            out.append(
+                DoubleMetric(
+                    self.entity,
+                    f"{self.name}.abs.{key}",
+                    self.instance,
+                    Success(float(dv.absolute)),
+                )
+            )
+            out.append(
+                DoubleMetric(
+                    self.entity,
+                    f"{self.name}.ratio.{key}",
+                    self.instance,
+                    Success(dv.ratio),
+                )
+            )
+        return tuple(out)
+
+    @staticmethod
+    def from_counts(
+        name: str, instance: str, counts: Dict[str, int], total: int
+    ) -> "HistogramMetric":
+        dist = Distribution(
+            {
+                k: DistributionValue(int(c), (c / total) if total else 0.0)
+                for k, c in counts.items()
+            },
+            number_of_bins=len(counts),
+        )
+        return HistogramMetric(Entity.COLUMN, name, instance, Success(dist))

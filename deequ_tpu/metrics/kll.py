@@ -1,0 +1,72 @@
+"""KLL quantile-sketch metric: bucket distribution + sketch parameters.
+
+Reference: ``src/main/scala/com/amazon/deequ/metrics/KLLMetric.scala``
+(SURVEY.md §2.1) — the metric carries a bucketed distribution derived from
+the sketch plus the sketch parameters and raw compactor data, so it can be
+persisted and re-queried.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
+from deequ_tpu.utils.trylike import Success
+
+
+@dataclass(frozen=True)
+class BucketValue:
+    low_value: float
+    high_value: float
+    count: int
+
+
+@dataclass(frozen=True)
+class BucketDistribution:
+    """Equi-width bucketing of a KLL sketch plus the sketch internals.
+
+    ``parameters`` = [shrinking_factor, sketch_size] as in the reference;
+    ``data`` = the compactor buffers (level -> weighted items).
+    """
+
+    buckets: List[BucketValue]
+    parameters: Tuple[float, ...]
+    data: Tuple[Tuple[float, ...], ...] = field(default=())
+
+    def apx_quantile_from_buckets(self, q: float) -> float:
+        total = sum(b.count for b in self.buckets)
+        if total == 0:
+            return float("nan")
+        target = q * total
+        running = 0
+        for b in self.buckets:
+            running += b.count
+            if running >= target:
+                return b.high_value
+        return self.buckets[-1].high_value
+
+
+@dataclass(frozen=True)
+class KLLMetric(Metric[BucketDistribution]):
+    def flatten(self) -> Sequence[DoubleMetric]:
+        if self.value.is_failure:
+            return (
+                DoubleMetric(self.entity, self.name, self.instance, self.value),
+            )
+        dist = self.value.get()
+        return tuple(
+            DoubleMetric(
+                self.entity,
+                f"{self.name}.bucket[{i}]",
+                self.instance,
+                Success(float(b.count)),
+            )
+            for i, b in enumerate(dist.buckets)
+        )
+
+    @staticmethod
+    def success(
+        name: str, instance: str, dist: BucketDistribution
+    ) -> "KLLMetric":
+        return KLLMetric(Entity.COLUMN, name, instance, Success(dist))

@@ -1,0 +1,15 @@
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    InMemoryMetricsRepository,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "InMemoryMetricsRepository",
+    "MetricsRepository",
+    "MetricsRepositoryMultipleResultsLoader",
+    "ResultKey",
+]

@@ -1,0 +1,13 @@
+from deequ_tpu.sql.predicate import (
+    CompiledPredicate,
+    PredicateParseError,
+    compile_predicate,
+    parse_predicate,
+)
+
+__all__ = [
+    "CompiledPredicate",
+    "PredicateParseError",
+    "compile_predicate",
+    "parse_predicate",
+]

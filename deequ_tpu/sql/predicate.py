@@ -1,0 +1,632 @@
+"""A small SQL-expression compiler for predicates over device columns.
+
+The reference's ``Compliance`` analyzer and ``.where(...)`` filters take
+arbitrary Spark SQL expression strings (reference:
+``src/main/scala/com/amazon/deequ/analyzers/Compliance.scala``,
+``checks/Check.scala``; SURVEY.md §2.2). deequ_tpu keeps that surface but
+compiles the expression to pure JAX ops at plan time:
+
+- numeric columns evaluate on their device ``values``;
+- string comparisons become *dictionary-code* operations — equality/IN
+  become host-side dictionary lookups producing code sets, LIKE/RLIKE
+  become a host-side regex sweep over the (small) dictionary producing a
+  device bool lookup table gathered by code. Strings never reach the TPU
+  (SURVEY.md §7 hard part #3).
+
+Three-valued logic follows SQL: comparisons involving NULL are NULL; a
+row "complies" iff the predicate is TRUE (not NULL, not FALSE).
+
+Supported grammar: OR / AND / NOT, comparisons (= == != <> < <= > >=),
+arithmetic (+ - * / %), IS [NOT] NULL, [NOT] IN (...), BETWEEN x AND y,
+[NOT] LIKE 'pat%' (SQL wildcards), RLIKE 'regex', unary minus, literals
+(numbers, 'strings', TRUE/FALSE/NULL), parentheses, and a few functions
+(ABS, LENGTH).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind
+
+# --------------------------------------------------------------------------
+# Tokenizer
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+  | (?P<bq_ident>`[^`]+`)
+  | (?P<op><=|>=|!=|<>|==|=|<|>|\+|-|\*|/|%|\(|\)|,)
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9.]*)
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "AND", "OR", "NOT", "IS", "NULL", "IN", "BETWEEN", "LIKE", "RLIKE",
+    "TRUE", "FALSE",
+}
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # 'number' | 'string' | 'ident' | 'op' | 'kw'
+    text: str
+
+
+def tokenize(expression: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    while pos < len(expression):
+        m = _TOKEN_RE.match(expression, pos)
+        if not m:
+            raise PredicateParseError(
+                f"cannot tokenize {expression[pos:pos + 20]!r} in predicate"
+            )
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        kind = m.lastgroup
+        if kind == "bq_ident":
+            tokens.append(Token("ident", text[1:-1]))
+        elif kind == "ident" and text.upper() in _KEYWORDS:
+            tokens.append(Token("kw", text.upper()))
+        else:
+            tokens.append(Token(kind, text))
+    return tokens
+
+
+class PredicateParseError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# AST
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Node:
+    pass
+
+
+@dataclass(frozen=True)
+class ColumnRef(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class NumberLit(Node):
+    value: float
+
+
+@dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class BoolLit(Node):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # 'NOT' | 'NEG'
+    operand: Node
+
+
+@dataclass(frozen=True)
+class BinOp(Node):
+    op: str  # 'AND','OR','=','!=','<','<=','>','>=','+','-','*','/','%'
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class IsNull(Node):
+    operand: Node
+    negate: bool
+
+
+@dataclass(frozen=True)
+class InList(Node):
+    operand: Node
+    items: Tuple[Node, ...]
+    negate: bool
+
+
+@dataclass(frozen=True)
+class Between(Node):
+    operand: Node
+    low: Node
+    high: Node
+
+
+@dataclass(frozen=True)
+class Like(Node):
+    operand: Node
+    pattern: str
+    regex: bool
+    negate: bool
+
+
+@dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: Tuple[Node, ...]
+
+
+class _Parser:
+    def __init__(self, tokens: List[Token]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> Optional[Token]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> Token:
+        tok = self.peek()
+        if tok is None:
+            raise PredicateParseError("unexpected end of predicate")
+        self.pos += 1
+        return tok
+
+    def accept(self, kind: str, text: Optional[str] = None) -> Optional[Token]:
+        tok = self.peek()
+        if tok and tok.kind == kind and (text is None or tok.text == text):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Token:
+        tok = self.accept(kind, text)
+        if tok is None:
+            got = self.peek()
+            raise PredicateParseError(
+                f"expected {text or kind}, got {got.text if got else 'EOF'!r}"
+            )
+        return tok
+
+    def parse(self) -> Node:
+        node = self.or_expr()
+        if self.peek() is not None:
+            raise PredicateParseError(
+                f"trailing tokens starting at {self.peek().text!r}"
+            )
+        return node
+
+    def or_expr(self) -> Node:
+        node = self.and_expr()
+        while self.accept("kw", "OR"):
+            node = BinOp("OR", node, self.and_expr())
+        return node
+
+    def and_expr(self) -> Node:
+        node = self.not_expr()
+        while self.accept("kw", "AND"):
+            node = BinOp("AND", node, self.not_expr())
+        return node
+
+    def not_expr(self) -> Node:
+        if self.accept("kw", "NOT"):
+            return UnaryOp("NOT", self.not_expr())
+        return self.comparison()
+
+    def comparison(self) -> Node:
+        node = self.additive()
+        tok = self.peek()
+        if tok is None:
+            return node
+        if tok.kind == "op" and tok.text in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            op = {"==": "=", "<>": "!="}.get(tok.text, tok.text)
+            return BinOp(op, node, self.additive())
+        if tok.kind == "kw" and tok.text == "IS":
+            self.next()
+            negate = self.accept("kw", "NOT") is not None
+            self.expect("kw", "NULL")
+            return IsNull(node, negate)
+        negate = False
+        if tok.kind == "kw" and tok.text == "NOT":
+            nxt = (
+                self.tokens[self.pos + 1]
+                if self.pos + 1 < len(self.tokens)
+                else None
+            )
+            if nxt and nxt.kind == "kw" and nxt.text in ("IN", "LIKE", "RLIKE"):
+                self.next()
+                negate = True
+                tok = self.peek()
+        if tok and tok.kind == "kw" and tok.text == "IN":
+            self.next()
+            self.expect("op", "(")
+            items = [self.additive()]
+            while self.accept("op", ","):
+                items.append(self.additive())
+            self.expect("op", ")")
+            return InList(node, tuple(items), negate)
+        if tok and tok.kind == "kw" and tok.text == "BETWEEN":
+            self.next()
+            low = self.additive()
+            self.expect("kw", "AND")
+            high = self.additive()
+            return Between(node, low, high)
+        if tok and tok.kind == "kw" and tok.text in ("LIKE", "RLIKE"):
+            self.next()
+            pat = self.next()
+            if pat.kind != "string":
+                raise PredicateParseError(
+                    f"{tok.text} expects a string pattern"
+                )
+            return Like(
+                node,
+                _unquote(pat.text),
+                regex=tok.text == "RLIKE",
+                negate=negate,
+            )
+        return node
+
+    def additive(self) -> Node:
+        node = self.multiplicative()
+        while True:
+            tok = self.peek()
+            if tok and tok.kind == "op" and tok.text in ("+", "-"):
+                self.next()
+                node = BinOp(tok.text, node, self.multiplicative())
+            else:
+                return node
+
+    def multiplicative(self) -> Node:
+        node = self.unary()
+        while True:
+            tok = self.peek()
+            if tok and tok.kind == "op" and tok.text in ("*", "/", "%"):
+                self.next()
+                node = BinOp(tok.text, node, self.unary())
+            else:
+                return node
+
+    def unary(self) -> Node:
+        if self.accept("op", "-"):
+            return UnaryOp("NEG", self.unary())
+        return self.primary()
+
+    def primary(self) -> Node:
+        tok = self.next()
+        if tok.kind == "number":
+            return NumberLit(float(tok.text))
+        if tok.kind == "string":
+            return StringLit(_unquote(tok.text))
+        if tok.kind == "kw" and tok.text == "TRUE":
+            return BoolLit(True)
+        if tok.kind == "kw" and tok.text == "FALSE":
+            return BoolLit(False)
+        if tok.kind == "kw" and tok.text == "NULL":
+            return NullLit()
+        if tok.kind == "op" and tok.text == "(":
+            node = self.or_expr()
+            self.expect("op", ")")
+            return node
+        if tok.kind == "ident":
+            if self.accept("op", "("):
+                args: List[Node] = []
+                if not self.accept("op", ")"):
+                    args.append(self.or_expr())
+                    while self.accept("op", ","):
+                        args.append(self.or_expr())
+                    self.expect("op", ")")
+                return FuncCall(tok.text.upper(), tuple(args))
+            return ColumnRef(tok.text)
+        raise PredicateParseError(f"unexpected token {tok.text!r}")
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(r"\\(.)", r"\1", body)
+
+
+def parse_predicate(expression: str) -> Node:
+    return _Parser(tokenize(expression)).parse()
+
+
+def _sql_like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+# --------------------------------------------------------------------------
+# Compiler: AST -> (requests, traced eval over batch)
+# --------------------------------------------------------------------------
+
+# An evaluated expression: (values, valid) with SQL null semantics, or for
+# booleans (truth, valid). `values` may be numeric or int32 codes tagged
+# with the column whose dictionary they index.
+
+
+@dataclass
+class _Val:
+    values: jnp.ndarray
+    valid: jnp.ndarray
+    is_bool: bool = False
+    codes_of: Optional[str] = None  # column name whose dictionary applies
+
+
+class CompiledPredicate:
+    """A predicate compiled against a dataset's schema + dictionaries.
+
+    ``requests`` lists the device columns needed; ``evaluate(batch)`` is
+    traceable and returns (truth: bool array, valid: bool array). A row
+    complies iff truth & valid.
+    """
+
+    def __init__(
+        self,
+        node: Node,
+        dataset: Dataset,
+        columns_used: Sequence[str],
+        requests: Sequence[ColumnRequest],
+    ):
+        self._node = node
+        self._dataset = dataset
+        self.columns_used = tuple(columns_used)
+        self.requests = tuple(requests)
+
+    def evaluate(self, batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        val = _eval(self._node, batch, self._dataset)
+        truth, valid = _as_bool(val)
+        return truth, valid
+
+    def complies(self, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        truth, valid = self.evaluate(batch)
+        return truth & valid
+
+
+def compile_predicate(expression: str, dataset: Dataset) -> CompiledPredicate:
+    # per-dataset compile cache: device_requests() and make_ops() both
+    # compile the same expressions during planning
+    cache = getattr(dataset, "_predicate_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(dataset, "_predicate_cache", cache)
+    if expression in cache:
+        return cache[expression]
+    node = parse_predicate(expression)
+    cols = sorted(_columns_of(node))
+    schema = dataset.schema
+    requests: List[ColumnRequest] = []
+    for c in cols:
+        if not schema.has_column(c):
+            raise KeyError(f"predicate references unknown column '{c}'")
+        kind = schema.kind_of(c)
+        if kind == Kind.STRING:
+            requests.append(ColumnRequest(c, "codes"))
+        else:
+            requests.append(ColumnRequest(c, "values"))
+        requests.append(ColumnRequest(c, "mask"))
+    for col in _length_columns_of(node):
+        requests.append(ColumnRequest(col, "lengths"))
+    compiled = CompiledPredicate(node, dataset, cols, requests)
+    cache[expression] = compiled
+    return compiled
+
+
+def _length_columns_of(node: Node) -> set:
+    """Columns appearing as LENGTH(col) — they need the 'lengths' repr."""
+    out: set = set()
+    if isinstance(node, FuncCall) and node.name == "LENGTH":
+        for arg in node.args:
+            if isinstance(arg, ColumnRef):
+                out.add(arg.name)
+    for attr in ("operand", "left", "right", "low", "high"):
+        child = getattr(node, attr, None)
+        if isinstance(child, Node):
+            out |= _length_columns_of(child)
+    for attr in ("items", "args"):
+        for child in getattr(node, attr, ()):
+            out |= _length_columns_of(child)
+    return out
+
+
+def _columns_of(node: Node) -> set:
+    if isinstance(node, ColumnRef):
+        return {node.name}
+    out: set = set()
+    for attr in ("operand", "left", "right", "low", "high"):
+        child = getattr(node, attr, None)
+        if isinstance(child, Node):
+            out |= _columns_of(child)
+    for attr in ("items", "args"):
+        children = getattr(node, attr, ())
+        for child in children:
+            out |= _columns_of(child)
+    return out
+
+
+def _as_bool(v: _Val) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    if v.is_bool:
+        return v.values.astype(bool), v.valid
+    return v.values != 0, v.valid
+
+
+def _dict_lookup(dataset: Dataset, column: str, value: str) -> int:
+    dictionary = dataset.dictionary(column)
+    matches = np.nonzero(dictionary == value)[0]
+    return int(matches[0]) if len(matches) else -2  # -2: matches nothing
+
+
+def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
+    if isinstance(node, ColumnRef):
+        kind = ds.schema.kind_of(node.name)
+        mask = batch[f"{node.name}::mask"]
+        if kind == Kind.STRING:
+            return _Val(batch[f"{node.name}::codes"], mask, codes_of=node.name)
+        vals = batch[f"{node.name}::values"]
+        return _Val(vals, mask, is_bool=kind == Kind.BOOLEAN)
+    if isinstance(node, NumberLit):
+        return _Val(jnp.asarray(node.value), jnp.asarray(True))
+    if isinstance(node, BoolLit):
+        return _Val(jnp.asarray(node.value), jnp.asarray(True), is_bool=True)
+    if isinstance(node, NullLit):
+        return _Val(jnp.asarray(0.0), jnp.asarray(False))
+    if isinstance(node, StringLit):
+        # bare string literal only makes sense inside comparisons, which
+        # special-case it; standing alone it is an error
+        raise PredicateParseError(
+            f"string literal {node.value!r} outside comparison"
+        )
+    if isinstance(node, UnaryOp):
+        if node.op == "NEG":
+            v = _eval(node.operand, batch, ds)
+            return _Val(-v.values, v.valid)
+        truth, valid = _as_bool(_eval(node.operand, batch, ds))
+        return _Val(~truth, valid, is_bool=True)
+    if isinstance(node, IsNull):
+        v = _eval(node.operand, batch, ds)
+        res = v.valid if node.negate else ~v.valid
+        return _Val(res, jnp.ones_like(res, dtype=bool), is_bool=True)
+    if isinstance(node, Between):
+        return _eval(
+            BinOp(
+                "AND",
+                BinOp(">=", node.operand, node.low),
+                BinOp("<=", node.operand, node.high),
+            ),
+            batch,
+            ds,
+        )
+    if isinstance(node, InList):
+        base = _eval(node.operand, batch, ds)
+        truth = jnp.zeros_like(base.values, dtype=bool)
+        has_null_item = False
+        for item in node.items:
+            if isinstance(item, NullLit):
+                # SQL: x IN (..., NULL) is TRUE on a match, else NULL
+                has_null_item = True
+            elif isinstance(item, StringLit):
+                if base.codes_of is None:
+                    raise PredicateParseError(
+                        "IN with string literals requires a string column"
+                    )
+                code = _dict_lookup(ds, base.codes_of, item.value)
+                truth = truth | (base.values == code)
+            else:
+                rhs = _eval(item, batch, ds)
+                truth = truth | ((base.values == rhs.values) & rhs.valid)
+        valid = base.valid
+        if has_null_item:
+            valid = valid & truth  # non-matches become NULL
+        if node.negate:
+            truth = ~truth
+        return _Val(truth, valid, is_bool=True)
+    if isinstance(node, Like):
+        base = _eval(node.operand, batch, ds)
+        if base.codes_of is None:
+            raise PredicateParseError("LIKE requires a string column")
+        dictionary = ds.dictionary(base.codes_of)
+        pattern = (
+            node.pattern if node.regex else _sql_like_to_regex(node.pattern)
+        )
+        prog = re.compile(pattern)
+        table = np.zeros(len(dictionary) + 1, dtype=bool)
+        for i, s in enumerate(dictionary):
+            if s is not None and prog.search(str(s)):
+                table[i] = True
+        lut = jnp.asarray(table)
+        truth = lut[jnp.clip(base.values, -1, len(dictionary) - 1)]
+        truth = jnp.where(base.values < 0, False, truth)
+        if node.negate:
+            truth = ~truth
+        return _Val(truth, base.valid, is_bool=True)
+    if isinstance(node, FuncCall):
+        if node.name == "ABS" and len(node.args) == 1:
+            v = _eval(node.args[0], batch, ds)
+            return _Val(jnp.abs(v.values), v.valid)
+        if node.name == "LENGTH" and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ColumnRef):
+                mask = batch[f"{arg.name}::mask"]
+                return _Val(batch[f"{arg.name}::lengths"], mask)
+            raise PredicateParseError("LENGTH expects a column")
+        raise PredicateParseError(f"unsupported function {node.name}")
+    if isinstance(node, BinOp):
+        if node.op in ("AND", "OR"):
+            lt, lv = _as_bool(_eval(node.left, batch, ds))
+            rt, rv = _as_bool(_eval(node.right, batch, ds))
+            if node.op == "AND":
+                truth = lt & rt
+                # SQL 3VL: FALSE AND NULL = FALSE (valid)
+                valid = (lv & rv) | (lv & ~lt) | (rv & ~rt)
+            else:
+                truth = lt | rt
+                # TRUE OR NULL = TRUE (valid)
+                valid = (lv & rv) | (lv & lt) | (rv & rt)
+            return _Val(truth, valid, is_bool=True)
+        # comparisons involving string literals -> dictionary-code compare
+        if node.op in ("=", "!=") and (
+            isinstance(node.left, StringLit) or isinstance(node.right, StringLit)
+        ):
+            col_node, lit = (
+                (node.left, node.right)
+                if isinstance(node.right, StringLit)
+                else (node.right, node.left)
+            )
+            base = _eval(col_node, batch, ds)
+            if base.codes_of is None:
+                raise PredicateParseError(
+                    "string comparison requires a string column"
+                )
+            code = _dict_lookup(ds, base.codes_of, lit.value)
+            truth = base.values == code
+            if node.op == "!=":
+                truth = ~truth
+            return _Val(truth, base.valid, is_bool=True)
+        lhs = _eval(node.left, batch, ds)
+        rhs = _eval(node.right, batch, ds)
+        valid = lhs.valid & rhs.valid
+        lv, rv = lhs.values, rhs.values
+        if node.op in ("=", "!=", "<", "<=", ">", ">="):
+            fn = {
+                "=": jnp.equal,
+                "!=": jnp.not_equal,
+                "<": jnp.less,
+                "<=": jnp.less_equal,
+                ">": jnp.greater,
+                ">=": jnp.greater_equal,
+            }[node.op]
+            return _Val(fn(lv, rv), valid, is_bool=True)
+        if node.op == "+":
+            return _Val(lv + rv, valid)
+        if node.op == "-":
+            return _Val(lv - rv, valid)
+        if node.op == "*":
+            return _Val(lv * rv, valid)
+        if node.op == "/":
+            denom_ok = rv != 0
+            safe = jnp.where(denom_ok, rv, 1)
+            return _Val(lv / safe, valid & denom_ok)
+        if node.op == "%":
+            denom_ok = rv != 0
+            safe = jnp.where(denom_ok, rv, 1)
+            return _Val(lv % safe, valid & denom_ok)
+    raise PredicateParseError(f"cannot evaluate node {node!r}")

@@ -1,0 +1,3 @@
+from deequ_tpu.utils.trylike import Failure, Success, Try
+
+__all__ = ["Failure", "Success", "Try"]

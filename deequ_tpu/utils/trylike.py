@@ -1,0 +1,107 @@
+"""Scala-style ``Try`` values: failures are data, not control flow.
+
+The reference wraps every metric value in ``Try[Value]`` so a failed
+analyzer (missing column, empty state, cast error) produces a *failure
+metric* and the run still completes (reference:
+``src/main/scala/com/amazon/deequ/metrics/Metric.scala``; SURVEY.md §2.1,
+§5.3). This module is the Python equivalent used throughout deequ_tpu.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+class Try(Generic[T]):
+    """Either a ``Success(value)`` or a ``Failure(exception)``."""
+
+    @property
+    def is_success(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def is_failure(self) -> bool:
+        return not self.is_success
+
+    def get(self) -> T:
+        raise NotImplementedError
+
+    def get_or_else(self, default: U) -> T | U:
+        return self.get() if self.is_success else default
+
+    @property
+    def exception(self) -> BaseException | None:
+        return None
+
+    def map(self, fn: Callable[[T], U]) -> "Try[U]":
+        raise NotImplementedError
+
+    @staticmethod
+    def of(fn: Callable[[], T]) -> "Try[T]":
+        try:
+            return Success(fn())
+        except Exception as exc:  # noqa: BLE001 — failures-as-values by design
+            return Failure(exc)
+
+
+class Success(Try[T]):
+    __slots__ = ("_value",)
+
+    def __init__(self, value: T):
+        self._value = value
+
+    @property
+    def is_success(self) -> bool:
+        return True
+
+    def get(self) -> T:
+        return self._value
+
+    def map(self, fn: Callable[[T], U]) -> Try[U]:
+        return Try.of(lambda: fn(self._value))
+
+    def __repr__(self) -> str:
+        return f"Success({self._value!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Success) and other._value == self._value
+
+    def __hash__(self) -> int:
+        return hash(("Success", self._value))
+
+
+class Failure(Try[T]):
+    __slots__ = ("_exception",)
+
+    def __init__(self, exception: BaseException):
+        self._exception = exception
+
+    @property
+    def is_success(self) -> bool:
+        return False
+
+    def get(self) -> T:
+        raise self._exception
+
+    @property
+    def exception(self) -> BaseException:
+        return self._exception
+
+    def map(self, fn: Callable[[T], U]) -> Try[U]:
+        return Failure(self._exception)
+
+    def __repr__(self) -> str:
+        return f"Failure({self._exception!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Failure)
+            and type(other._exception) is type(self._exception)
+            and str(other._exception) == str(self._exception)
+        )
+
+    def __hash__(self) -> int:
+        return hash(("Failure", type(self._exception), str(self._exception)))

@@ -1,0 +1,11 @@
+from deequ_tpu.verification.suite import (
+    VerificationResult,
+    VerificationRunBuilder,
+    VerificationSuite,
+)
+
+__all__ = [
+    "VerificationResult",
+    "VerificationRunBuilder",
+    "VerificationSuite",
+]

@@ -1,0 +1,23 @@
+"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax import,
+so every 'distributed' behavior is tested on a fake mesh with no real
+cluster — the TPU transfer of the reference's local-Spark fixture
+(SURVEY.md §4: SparkContextSpec -> virtual-device mesh)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cpu_mesh():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = np.array(jax.devices("cpu")[:8])
+    return Mesh(devices, ("dp",))

@@ -1,0 +1,171 @@
+"""Per-analyzer exact-value tests on fixture data (reference test shape:
+``analyzers/AnalyzerTests.scala`` — SURVEY.md §4)."""
+
+import math
+
+import pytest
+
+from deequ_tpu.analyzers import (
+    Completeness,
+    Compliance,
+    Correlation,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    PatternMatch,
+    RatioOfSums,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.base import (
+    EmptyStateException,
+    NoSuchColumnException,
+    WrongColumnTypeException,
+)
+from deequ_tpu.analyzers.datatype import DataType
+
+from fixtures import (
+    df_full,
+    df_missing,
+    df_numeric,
+    df_numeric_with_nulls,
+    df_strings,
+)
+
+
+def value(metric):
+    assert metric.value.is_success, f"metric failed: {metric.value}"
+    return metric.value.get()
+
+
+class TestSize:
+    def test_full(self):
+        assert value(Size().calculate(df_full())) == 4.0
+
+    def test_missing(self):
+        assert value(Size().calculate(df_missing())) == 12.0
+
+    def test_with_filter(self):
+        metric = Size(where="att1 IS NOT NULL").calculate(df_missing())
+        assert value(metric) == 10.0
+
+
+class TestCompleteness:
+    def test_complete_column(self):
+        assert value(Completeness("item").calculate(df_missing())) == 1.0
+
+    def test_att1(self):
+        assert value(Completeness("att1").calculate(df_missing())) == 10 / 12
+
+    def test_att2(self):
+        assert value(Completeness("att2").calculate(df_missing())) == 6 / 12
+
+    def test_missing_column_fails(self):
+        metric = Completeness("nope").calculate(df_missing())
+        assert metric.value.is_failure
+        assert isinstance(metric.value.exception, NoSuchColumnException)
+
+    def test_with_filter(self):
+        # among rows where att1 = 'a' (7 rows), att2 is non-null in 6
+        metric = Completeness("att2", where="att1 = 'a'").calculate(
+            df_missing()
+        )
+        assert value(metric) == pytest.approx(6 / 7)
+
+
+class TestNumeric:
+    def test_mean(self):
+        assert value(Mean("att1").calculate(df_numeric())) == 3.5
+
+    def test_mean_with_filter(self):
+        metric = Mean("att1", where="att2 = 0").calculate(df_numeric())
+        assert value(metric) == 2.0
+
+    def test_sum(self):
+        assert value(Sum("att1").calculate(df_numeric())) == 21.0
+
+    def test_min_max(self):
+        assert value(Minimum("att1").calculate(df_numeric())) == 1.0
+        assert value(Maximum("att1").calculate(df_numeric())) == 6.0
+
+    def test_stddev(self):
+        # population stddev of 1..6 = sqrt(17.5/6)
+        metric = StandardDeviation("att1").calculate(df_numeric())
+        assert value(metric) == pytest.approx(math.sqrt(17.5 / 6))
+
+    def test_nulls_ignored(self):
+        ds = df_numeric_with_nulls()
+        assert value(Mean("att1").calculate(ds)) == 3.0  # (1+3+5)/3
+        assert value(Sum("att2").calculate(ds)) == 16.0
+        assert value(Minimum("att1").calculate(ds)) == 1.0
+
+    def test_wrong_type_fails(self):
+        metric = Mean("att1").calculate(df_full())  # string column
+        assert metric.value.is_failure
+        assert isinstance(metric.value.exception, WrongColumnTypeException)
+
+    def test_empty_fails(self):
+        from deequ_tpu.data import Dataset
+        import pyarrow as pa
+
+        empty = Dataset.from_arrow(
+            pa.table({"att1": pa.array([], pa.float64())})
+        )
+        metric = Mean("att1").calculate(empty)
+        assert metric.value.is_failure
+        assert isinstance(metric.value.exception, EmptyStateException)
+
+    def test_correlation(self):
+        import numpy as np
+
+        metric = Correlation("att1", "att2").calculate(df_numeric())
+        expected = np.corrcoef([1, 2, 3, 4, 5, 6], [0, 0, 0, 5, 6, 7])[0, 1]
+        assert value(metric) == pytest.approx(float(expected))
+
+    def test_ratio_of_sums(self):
+        metric = RatioOfSums("att1", "att2").calculate(df_numeric())
+        assert value(metric) == pytest.approx(21.0 / 18.0)
+
+
+class TestCompliance:
+    def test_predicate(self):
+        metric = Compliance("att1 big", "att1 >= 4").calculate(df_numeric())
+        assert value(metric) == 0.5
+
+    def test_string_equality(self):
+        metric = Compliance("att1 is a", "att1 = 'a'").calculate(df_full())
+        assert value(metric) == 0.5
+
+    def test_in_list(self):
+        metric = Compliance("vals", "att2 IN ('c', 'd')").calculate(df_full())
+        assert value(metric) == 1.0
+
+    def test_null_predicate_rows_not_compliant(self):
+        metric = Compliance("att1 present", "att1 IS NOT NULL").calculate(
+            df_missing()
+        )
+        assert value(metric) == 10 / 12
+
+
+class TestStrings:
+    def test_min_max_length(self):
+        ds = df_strings()
+        assert value(MinLength("name").calculate(ds)) == 3.0
+        assert value(MaxLength("name").calculate(ds)) == 6.0
+
+    def test_pattern_match(self):
+        metric = PatternMatch(
+            "email", r"^[^@]+@[^@]+\.[a-z]+$"
+        ).calculate(df_strings())
+        assert value(metric) == 0.75
+
+    def test_datatype(self):
+        metric = DataType("typed").calculate(df_strings())
+        dist = value(metric)
+        assert dist.values["Integral"].absolute == 1
+        assert dist.values["Fractional"].absolute == 1
+        assert dist.values["Boolean"].absolute == 1
+        assert dist.values["String"].absolute == 1

@@ -1,0 +1,180 @@
+"""End-to-end Check DSL + VerificationSuite tests (reference shape:
+``checks/CheckTest.scala`` + ``VerificationSuiteTest.scala``)."""
+
+import pytest
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+from deequ_tpu.checks import ConstrainableDataTypes
+from deequ_tpu.constraints import ConstraintStatus
+from fixtures import df_full, df_missing, df_numeric, df_strings, df_unique
+
+
+def run(data, *checks):
+    builder = VerificationSuite().on_data(data)
+    for check in checks:
+        builder = builder.add_check(check)
+    return builder.run()
+
+
+class TestBasicChecks:
+    def test_success(self):
+        check = (
+            Check(CheckLevel.ERROR, "basic")
+            .has_size(lambda s: s == 4)
+            .is_complete("att1")
+            .has_completeness("att1", lambda c: c == 1.0)
+        )
+        result = run(df_full(), check)
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_failure(self):
+        check = Check(CheckLevel.ERROR, "basic").is_complete("att2")
+        result = run(df_missing(), check)
+        assert result.status == CheckStatus.ERROR
+
+    def test_warning_level(self):
+        check = Check(CheckLevel.WARNING, "warn").is_complete("att2")
+        result = run(df_missing(), check)
+        assert result.status == CheckStatus.WARNING
+
+    def test_mixed_status_takes_worst(self):
+        ok = Check(CheckLevel.ERROR, "ok").has_size(lambda s: s == 12)
+        warn = Check(CheckLevel.WARNING, "warn").is_complete("att2")
+        result = run(df_missing(), ok, warn)
+        assert result.status == CheckStatus.WARNING
+
+    def test_constraint_messages(self):
+        check = Check(CheckLevel.ERROR, "sized").has_size(lambda s: s > 100)
+        result = run(df_full(), check)
+        (check_result,) = result.check_results.values()
+        (constraint_result,) = check_result.constraint_results
+        assert constraint_result.status == ConstraintStatus.FAILURE
+        assert "4.0" in constraint_result.message
+
+
+class TestNumericChecks:
+    def test_stats(self):
+        check = (
+            Check(CheckLevel.ERROR, "numbers")
+            .has_min("att1", lambda v: v == 1.0)
+            .has_max("att1", lambda v: v == 6.0)
+            .has_mean("att1", lambda v: v == 3.5)
+            .has_sum("att1", lambda v: v == 21.0)
+            .has_standard_deviation("att1", lambda v: abs(v - 1.707825) < 1e-5)
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+    def test_is_non_negative_and_positive(self):
+        check = (
+            Check(CheckLevel.ERROR, "sign")
+            .is_non_negative("att2")
+            .is_positive("att1")
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+    def test_column_comparisons(self):
+        check = Check(CheckLevel.ERROR, "cmp").is_less_than_or_equal_to(
+            "att2", "att1", lambda v: v >= 0.5
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+    def test_correlation(self):
+        check = Check(CheckLevel.ERROR, "corr").has_correlation(
+            "att1", "att2", lambda v: v > 0.9
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+
+class TestUniquenessChecks:
+    def test_is_unique(self):
+        check = Check(CheckLevel.ERROR, "uni").is_unique("unique")
+        assert run(df_unique(), check).status == CheckStatus.SUCCESS
+
+    def test_has_uniqueness_multi(self):
+        check = Check(CheckLevel.ERROR, "uni").has_uniqueness(
+            ("att1", "att2"), lambda v: v == 0.5
+        )
+        assert run(df_full(), check).status == CheckStatus.SUCCESS
+
+    def test_distinctness(self):
+        check = Check(CheckLevel.ERROR, "d").has_distinctness(
+            "non_unique", lambda v: v == 0.6
+        )
+        assert run(df_unique(), check).status == CheckStatus.SUCCESS
+
+    def test_number_of_distinct_values(self):
+        check = Check(CheckLevel.ERROR, "n").has_number_of_distinct_values(
+            "half", lambda v: v == 4
+        )
+        assert run(df_unique(), check).status == CheckStatus.SUCCESS
+
+
+class TestPredicatesAndPatterns:
+    def test_satisfies(self):
+        # att2 - att1 > 0 holds for rows 4..6 only
+        check = Check(CheckLevel.ERROR, "sat").satisfies(
+            "att2 - att1 > 0", "att2 exceeds att1", lambda v: v == 0.5
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+    def test_is_contained_in(self):
+        check = Check(CheckLevel.ERROR, "in").is_contained_in(
+            "att1", ["a", "b"]
+        )
+        assert run(df_full(), check).status == CheckStatus.SUCCESS
+
+    def test_is_in_range(self):
+        check = Check(CheckLevel.ERROR, "range").is_in_range("att1", 1, 6)
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+    def test_contains_email(self):
+        check = Check(CheckLevel.ERROR, "email").contains_email(
+            "email", lambda v: v == 0.75
+        )
+        assert run(df_strings(), check).status == CheckStatus.SUCCESS
+
+    def test_has_pattern_with_where(self):
+        check = (
+            Check(CheckLevel.ERROR, "f")
+            .has_completeness("att2", lambda c: c == 1.0)
+            .where("att1 = 'b'")
+        )
+        assert run(df_missing(), check).status == CheckStatus.ERROR
+
+    def test_where_filter_success(self):
+        # rows with att2 = 0 have att1 in 1..3
+        check = (
+            Check(CheckLevel.ERROR, "f")
+            .has_max("att1", lambda v: v == 3.0)
+            .where("att2 = 0")
+        )
+        assert run(df_numeric(), check).status == CheckStatus.SUCCESS
+
+
+class TestDataTypeChecks:
+    def test_has_data_type(self):
+        check = Check(CheckLevel.ERROR, "dt").has_data_type(
+            "typed", ConstrainableDataTypes.NUMERIC, lambda v: v == 0.5
+        )
+        assert run(df_strings(), check).status == CheckStatus.SUCCESS
+
+
+class TestMetricsExport:
+    def test_success_metrics_records(self):
+        check = (
+            Check(CheckLevel.ERROR, "m")
+            .has_size(lambda s: s == 4)
+            .is_complete("att1")
+        )
+        result = run(df_full(), check)
+        records = result.success_metrics_as_records()
+        by_name = {(r["name"], r["instance"]): r["value"] for r in records}
+        assert by_name[("Size", "*")] == 4.0
+        assert by_name[("Completeness", "att1")] == 1.0
+
+    def test_missing_analysis(self):
+        from deequ_tpu.analyzers.runner import AnalyzerContext
+
+        check = Check(CheckLevel.ERROR, "m").has_size(lambda s: True)
+        result = VerificationSuite.evaluate([check], AnalyzerContext.empty())
+        assert result.status == CheckStatus.ERROR

@@ -1,0 +1,120 @@
+"""Frequency-based analyzer tests (reference shape: per-analyzer tests +
+AnalysisRunnerTests' shared-groupBy assertions — SURVEY.md §4)."""
+
+import math
+
+import pytest
+
+from deequ_tpu.analyzers import (
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Histogram,
+    MutualInformation,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from fixtures import df_full, df_missing, df_unique
+
+
+def value(metric):
+    assert metric.value.is_success, f"metric failed: {metric.value}"
+    return metric.value.get()
+
+
+class TestUniquenessFamily:
+    def test_unique_column(self):
+        assert value(Uniqueness("unique").calculate(df_unique())) == 1.0
+
+    def test_non_unique(self):
+        # non_unique: a,a,b,b,c -> only 'c' occurs once -> 1/5
+        assert value(Uniqueness("non_unique").calculate(df_unique())) == 0.2
+
+    def test_half(self):
+        # half: a,a,b,c,d -> b,c,d unique -> 3/5
+        assert value(Uniqueness("half").calculate(df_unique())) == 0.6
+
+    def test_unique_value_ratio(self):
+        # half: 4 distinct, 3 unique -> 3/4
+        assert (
+            value(UniqueValueRatio("half").calculate(df_unique())) == 0.75
+        )
+
+    def test_distinctness(self):
+        assert value(Distinctness("non_unique").calculate(df_unique())) == 0.6
+
+    def test_count_distinct(self):
+        assert value(CountDistinct("non_unique").calculate(df_unique())) == 3.0
+
+    def test_nulls_excluded_single_column(self):
+        # att1 in df_missing: 10 non-null (7 a, 3 b), 2 null rows dropped
+        assert value(Distinctness("att1").calculate(df_missing())) == 2 / 10
+
+    def test_multi_column(self):
+        # (att1, att2) pairs in df_full: (a,c),(b,d),(a,d),(b,d) -> 3 groups
+        metric = CountDistinct(("att1", "att2")).calculate(df_full())
+        assert value(metric) == 3.0
+
+
+class TestEntropy:
+    def test_entropy(self):
+        # att1 in df_full: a:2, b:2 -> ln 2
+        assert value(Entropy("att1").calculate(df_full())) == pytest.approx(
+            math.log(2)
+        )
+
+    def test_entropy_skewed(self):
+        # att2: c:1, d:3
+        expected = -(0.25 * math.log(0.25) + 0.75 * math.log(0.75))
+        assert value(Entropy("att2").calculate(df_full())) == pytest.approx(
+            expected
+        )
+
+
+class TestMutualInformation:
+    def test_identical_columns(self):
+        # MI(X, X) == H(X)
+        mi = value(
+            MutualInformation(("att1", "att1")).calculate(df_full())
+        )
+        assert mi == pytest.approx(math.log(2))
+
+    def test_independent(self):
+        from deequ_tpu.data import Dataset
+
+        ds = Dataset.from_pydict(
+            {
+                "a": ["x", "x", "y", "y"],
+                "b": ["u", "v", "u", "v"],
+            }
+        )
+        mi = value(MutualInformation(("a", "b")).calculate(ds))
+        assert mi == pytest.approx(0.0, abs=1e-12)
+
+
+class TestHistogram:
+    def test_basic(self):
+        dist = value(Histogram("att2").calculate(df_full()))
+        assert dist.number_of_bins == 2
+        assert dist["d"].absolute == 3
+        assert dist["d"].ratio == 0.75
+
+    def test_nulls_binned(self):
+        dist = value(Histogram("att2").calculate(df_missing()))
+        assert dist["NullValue"].absolute == 6
+        assert dist["f"].absolute == 4
+        assert dist["d"].absolute == 2
+
+    def test_max_detail_bins(self):
+        dist = value(
+            Histogram("item", max_detail_bins=2).calculate(df_missing())
+        )
+        # detail capped at 2 bins but the true distinct count is reported
+        assert len(dist.values) == 2
+        assert dist.number_of_bins == 12
+
+    def test_numeric_column(self):
+        from fixtures import df_numeric
+
+        dist = value(Histogram("att2").calculate(df_numeric()))
+        assert dist["0"].absolute == 3

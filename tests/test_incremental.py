@@ -1,0 +1,140 @@
+"""Incremental/merge correctness: split a fixture across partitions,
+compute per-partition states, merge via run_on_aggregated_states, and
+assert equality with metrics on the union — the multi-node simulation
+(reference: StateAggregationTests / IncrementalAnalysisTest, SURVEY.md §4).
+Plus state-provider round-trips (StateProviderTest shape)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    Completeness,
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.io import FileSystemStateProvider, InMemoryStateProvider
+from fixtures import big_numeric, df_missing
+
+
+ANALYZERS = [
+    Size(),
+    Completeness("att1"),
+    Completeness("att2"),
+    Distinctness("att1"),
+    Uniqueness("att1"),
+    CountDistinct("att2"),
+    Entropy("att1"),
+    Histogram("att2"),
+]
+
+
+def _split(dataset: Dataset, parts: int):
+    n = dataset.num_rows
+    bounds = np.linspace(0, n, parts + 1).astype(int)
+    out = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        mask = np.zeros(n, dtype=bool)
+        mask[lo:hi] = True
+        out.append(dataset.filter_rows(mask))
+    return out
+
+
+def _assert_metric_equal(ma, mb, analyzer):
+    assert ma.value.is_success == mb.value.is_success, analyzer
+    if not ma.value.is_success:
+        return
+    a, b = ma.value.get(), mb.value.get()
+    if isinstance(a, float):
+        assert a == pytest.approx(b), analyzer
+    else:  # distributions
+        assert a == b, analyzer
+
+
+def test_partitioned_states_merge_to_global():
+    data = df_missing()
+    providers = []
+    for part in _split(data, 3):
+        provider = InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(
+            part, ANALYZERS, save_states_with=provider
+        )
+        providers.append(provider)
+
+    merged = AnalysisRunner.run_on_aggregated_states(
+        data.schema, ANALYZERS, providers
+    )
+    full = AnalysisRunner.do_analysis_run(data, ANALYZERS)
+    for analyzer in ANALYZERS:
+        _assert_metric_equal(
+            merged.metric(analyzer), full.metric(analyzer), analyzer
+        )
+
+
+def test_numeric_states_merge_to_global():
+    data = big_numeric(20_000)
+    analyzers = [
+        Mean("x"),
+        Sum("x"),
+        Minimum("x"),
+        Maximum("x"),
+        StandardDeviation("x"),
+    ]
+    providers = []
+    for part in _split(data, 4):
+        provider = InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(
+            part, analyzers, save_states_with=provider
+        )
+        providers.append(provider)
+    merged = AnalysisRunner.run_on_aggregated_states(
+        data.schema, analyzers, providers
+    )
+    full = AnalysisRunner.do_analysis_run(data, analyzers)
+    for analyzer in analyzers:
+        a = merged.metric(analyzer).value.get()
+        b = full.metric(analyzer).value.get()
+        assert a == pytest.approx(b, rel=1e-9), analyzer
+
+
+def test_aggregate_with_prior_states():
+    """aggregate_with: new data merged with persisted prior state."""
+    data = df_missing()
+    part_a, part_b = _split(data, 2)
+    provider = InMemoryStateProvider()
+    analyzers = [Size(), Completeness("att1")]
+    AnalysisRunner.do_analysis_run(
+        part_a, analyzers, save_states_with=provider
+    )
+    ctx = AnalysisRunner.do_analysis_run(
+        part_b, analyzers, aggregate_with=provider
+    )
+    assert ctx.metric(Size()).value.get() == 12.0
+    assert ctx.metric(Completeness("att1")).value.get() == 10 / 12
+
+
+def test_filesystem_state_roundtrip(tmp_path):
+    data = df_missing()
+    provider = FileSystemStateProvider(str(tmp_path))
+    AnalysisRunner.do_analysis_run(
+        data, ANALYZERS, save_states_with=provider
+    )
+    reloaded = FileSystemStateProvider(str(tmp_path))
+    merged = AnalysisRunner.run_on_aggregated_states(
+        data.schema, ANALYZERS, [reloaded]
+    )
+    full = AnalysisRunner.do_analysis_run(data, ANALYZERS)
+    for analyzer in ANALYZERS:
+        _assert_metric_equal(
+            merged.metric(analyzer), full.metric(analyzer), analyzer
+        )

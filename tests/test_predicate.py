@@ -1,0 +1,85 @@
+"""Predicate DSL unit tests, incl. SQL three-valued-logic regressions."""
+
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import Compliance, Maximum, Mean
+from deequ_tpu.data import Dataset
+from deequ_tpu.sql import PredicateParseError, parse_predicate
+
+
+def compliance(ds, predicate):
+    metric = Compliance("t", predicate).calculate(ds)
+    assert metric.value.is_success, metric.value
+    return metric.value.get()
+
+
+@pytest.fixture
+def numeric_ds():
+    return Dataset.from_pydict({"x": [0, 1, 2, 3], "y": [3, 2, 1, 0]})
+
+
+class TestPredicates:
+    def test_comparisons(self, numeric_ds):
+        assert compliance(numeric_ds, "x >= 2") == 0.5
+        assert compliance(numeric_ds, "x < y") == 0.5
+        assert compliance(numeric_ds, "x + y = 3") == 1.0
+        assert compliance(numeric_ds, "x * 2 > y") == 0.5
+
+    def test_boolean_logic(self, numeric_ds):
+        assert compliance(numeric_ds, "x > 0 AND y > 0") == 0.5
+        assert compliance(numeric_ds, "x = 0 OR y = 0") == 0.5
+        assert compliance(numeric_ds, "NOT (x = 0)") == 0.75
+
+    def test_between(self, numeric_ds):
+        assert compliance(numeric_ds, "x BETWEEN 1 AND 2") == 0.5
+
+    def test_in_list_numeric(self, numeric_ds):
+        assert compliance(numeric_ds, "x IN (0, 3)") == 0.5
+        assert compliance(numeric_ds, "x NOT IN (0, 3)") == 0.5
+
+    def test_in_list_with_null_literal(self, numeric_ds):
+        # SQL 3VL: x IN (1, NULL) is TRUE only on a match, else NULL
+        assert compliance(numeric_ds, "x IN (1, NULL)") == 0.25
+        assert compliance(numeric_ds, "x IN (NULL)") == 0.0
+        # x NOT IN (1, NULL): never TRUE (non-matches are NULL)
+        assert compliance(numeric_ds, "x NOT IN (1, NULL)") == 0.0
+
+    def test_null_comparisons_not_compliant(self):
+        ds = Dataset.from_arrow(
+            pa.table({"x": pa.array([1.0, None, 3.0], pa.float64())})
+        )
+        assert compliance(ds, "x > 0") == pytest.approx(2 / 3)
+        assert compliance(ds, "x IS NULL") == pytest.approx(1 / 3)
+        assert compliance(ds, "x IS NOT NULL") == pytest.approx(2 / 3)
+
+    def test_division_by_zero_is_null(self, numeric_ds):
+        # y = 0 in the last row -> x / y is NULL there
+        assert compliance(numeric_ds, "x / y >= 0") == 0.75
+
+    def test_string_like(self):
+        ds = Dataset.from_pydict({"s": ["apple", "banana", "cherry", None]})
+        assert compliance(ds, "s LIKE 'b%'") == 0.25
+        assert compliance(ds, "s RLIKE 'an'") == 0.25
+        assert compliance(ds, "s NOT LIKE 'b%'") == 0.5  # null not compliant
+
+    def test_length_function(self):
+        ds = Dataset.from_pydict({"s": ["a", "bb", "ccc", None]})
+        assert compliance(ds, "LENGTH(s) >= 2") == 0.5
+
+    def test_parse_errors(self):
+        with pytest.raises(PredicateParseError):
+            parse_predicate("x >>> 1")
+        with pytest.raises(PredicateParseError):
+            parse_predicate("AND x")
+
+
+class TestNullableBoolean:
+    def test_numeric_analyzers_on_nullable_bool(self):
+        ds = Dataset.from_arrow(
+            pa.table({"b": pa.array([True, None, False, True])})
+        )
+        mean = Mean("b").calculate(ds)
+        assert mean.value.is_success, mean.value
+        assert mean.value.get() == pytest.approx(2 / 3)
+        assert Maximum("b").calculate(ds).value.get() == 1.0

@@ -1,0 +1,53 @@
+"""Scan-sharing regression: the reference asserts N scan-shareable
+analyzers trigger exactly ONE aggregation job by counting Spark jobs
+(SparkMonitor; SURVEY.md §4). The TPU equivalent: count compilations of
+the fused update — many analyzers, many batches, ONE trace."""
+
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    Completeness,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.engine import AnalysisEngine
+from fixtures import big_numeric
+
+
+def test_one_compile_for_many_analyzers_and_batches():
+    engine = AnalysisEngine(batch_size=16_384)  # 100k rows -> 7 batches
+    analyzers = [
+        Size(),
+        Completeness("x"),
+        Mean("x"),
+        Sum("x"),
+        Minimum("x"),
+        Maximum("x"),
+        StandardDeviation("x"),
+        Mean("y"),
+        Maximum("y"),
+    ]
+    context = AnalysisRunner.do_analysis_run(
+        big_numeric(), analyzers, engine=engine
+    )
+    assert all(m.value.is_success for m in context.metric_map.values())
+    # ONE fused computation for 9 analyzers over 7 batches
+    assert engine.trace_count == 1
+
+
+def test_batched_equals_single_batch():
+    data = big_numeric()
+    analyzers = [Mean("x"), StandardDeviation("x"), Minimum("x"), Sum("y")]
+    ctx_one = AnalysisRunner.do_analysis_run(
+        data, analyzers, engine=AnalysisEngine()
+    )
+    ctx_many = AnalysisRunner.do_analysis_run(
+        data, analyzers, engine=AnalysisEngine(batch_size=4_096)
+    )
+    for analyzer in analyzers:
+        a = ctx_one.metric(analyzer).value.get()
+        b = ctx_many.metric(analyzer).value.get()
+        assert abs(a - b) < 1e-8 * max(1.0, abs(a)), analyzer
