@@ -159,11 +159,18 @@ Batch = Dict[str, Any]
 class ScanOps:
     """The (identity, update, merge) triple for one analyzer, compiled
     against a concrete dataset (closures hold dictionaries / compiled
-    predicates)."""
+    predicates).
+
+    Host-folded analyzers (KLL): ``update`` emits a small fixed-shape
+    per-batch device output instead of a running carry, and the engine
+    folds it into a host accumulator via ``host_fold`` after each batch
+    — only k floats cross the boundary, the data pass stays fused."""
 
     init: Callable[[], StateTree]
     update: Callable[[StateTree, Batch], StateTree]
     merge: Callable[[StateTree, StateTree], StateTree]
+    host_init: Optional[Callable[[], Any]] = None
+    host_fold: Optional[Callable[[Any, Any], Any]] = None
 
 
 # --------------------------------------------------------------------------

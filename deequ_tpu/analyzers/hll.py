@@ -1,0 +1,95 @@
+"""ApproxCountDistinct: HLL cardinality estimate.
+
+Reference: ``analyzers/ApproxCountDistinct.scala`` + the
+``StatefulHyperloglogPlus`` Catalyst aggregate (SURVEY.md §2.2/§2.3).
+State = int32[2^14] registers; update = hash+clz+scatter-max inside the
+shared fused scan; merge = elementwise max (mesh all-reduce / persisted
+state merge). Nulls are ignored, matching the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    Precondition,
+    ScanOps,
+    ScanShareableAnalyzer,
+    has_column,
+)
+from deequ_tpu.analyzers.basic import _compile_where, _row_mask
+from deequ_tpu.analyzers.states import ApproxCountDistinctState
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind
+from deequ_tpu.metrics.metric import DoubleMetric
+from deequ_tpu.sketches import hll
+
+
+@dataclass(frozen=True)
+class ApproxCountDistinct(ScanShareableAnalyzer):
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Precondition]:
+        return [has_column(self.column)]
+
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        _, reqs = _compile_where(self.where, dataset)
+        kind = dataset.schema.kind_of(self.column)
+        value_repr = "codes" if kind == Kind.STRING else "values"
+        return [
+            ColumnRequest(self.column, value_repr),
+            ColumnRequest(self.column, "mask"),
+        ] + reqs
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        where_fn, _ = _compile_where(self.where, dataset)
+        col = self.column
+        kind = dataset.schema.kind_of(col)
+
+        if kind == Kind.STRING:
+            lut1_host, lut2_host = hll.dictionary_hash_pairs(
+                dataset.dictionary(col)
+            )
+            lut1, lut2 = jnp.asarray(lut1_host), jnp.asarray(lut2_host)
+
+            def hashes_of(batch):
+                codes = jnp.clip(batch[f"{col}::codes"], 0, lut1.shape[0] - 1)
+                return lut1[codes], lut2[codes]
+
+        else:
+
+            def hashes_of(batch):
+                return hll.hash_pair_numeric(batch[f"{col}::values"])
+
+        def init() -> ApproxCountDistinctState:
+            return ApproxCountDistinctState(np.zeros(hll.M, dtype=np.int32))
+
+        def update(state: ApproxCountDistinctState, batch):
+            mask = batch[f"{col}::mask"] & _row_mask(batch, where_fn)
+            h1, h2 = hashes_of(batch)
+            regs = hll.registers_from_hash_pair(h1, h2, mask)
+            return ApproxCountDistinctState(
+                jnp.maximum(state.registers, regs)
+            )
+
+        return ScanOps(init, update, ApproxCountDistinctState.merge)
+
+    def compute_metric_from_state(self, state) -> DoubleMetric:
+        if state is None:
+            return DoubleMetric.success(
+                self.entity, "ApproxCountDistinct", self.instance, 0.0
+            )
+        return DoubleMetric.success(
+            self.entity,
+            "ApproxCountDistinct",
+            self.instance,
+            hll.estimate(np.asarray(state.registers)),
+        )

@@ -1,0 +1,245 @@
+"""KLL-backed quantile analyzers: KLLSketch, ApproxQuantile,
+ApproxQuantiles.
+
+Reference: ``analyzers/KLLSketch.scala`` / ``ApproxQuantile.scala`` /
+``ApproxQuantiles.scala`` (SURVEY.md §2.2; the reference's
+StatefulApproxQuantile is superseded by KLL per §2.3). The device side
+of the update rides the shared fused scan: sort the batch, emit k
+strided samples at a static compaction level (fixed shapes — SURVEY.md
+§7 hard part #2); the host folds them into the compactor hierarchy
+(deequ_tpu.sketches.kll), which is also the incremental/mesh merge.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    EmptyStateException,
+    Precondition,
+    ScanOps,
+    ScanShareableAnalyzer,
+    has_column,
+    is_numeric,
+)
+from deequ_tpu.analyzers.basic import _compile_where, _row_mask
+from deequ_tpu.data.table import ColumnRequest, Dataset
+from deequ_tpu.metrics.kll import BucketDistribution, BucketValue, KLLMetric
+from deequ_tpu.metrics.metric import DoubleMetric, Entity, KeyedDoubleMetric, Metric
+from deequ_tpu.sketches.hll import fmix32
+from deequ_tpu.sketches.kll import KLLParameters, KLLSketchState
+from deequ_tpu.utils.trylike import Success
+
+_F64 = jnp.float64
+
+
+def _make_kll_ops(
+    analyzer: "KLLSketch | ApproxQuantile | ApproxQuantiles",
+    dataset: Dataset,
+    params: KLLParameters,
+) -> ScanOps:
+    where_fn, _ = _compile_where(analyzer.where, dataset)
+    col = analyzer.column
+    k = params.sketch_size
+
+    def init():
+        # per-batch output slot (overwritten each batch, not a carry)
+        return (
+            np.zeros(k, dtype=np.float32),  # samples
+            np.zeros(k, dtype=bool),  # sample validity
+            np.int64(0),  # valid count
+            np.float32(np.inf),  # min
+            np.float32(-np.inf),  # max
+            np.int32(0),  # compaction level
+        )
+
+    def update(_state, batch):
+        # device kernel stays in f32/u32 lanes: TPU-native (no x64
+        # emulation in the sort); the host compactor hierarchy is f64
+        mask = batch[f"{col}::mask"] & _row_mask(batch, where_fn)
+        x = batch[f"{col}::values"].astype(jnp.float32)
+        # non-finite values cannot enter the compactors (they'd corrupt
+        # sort/searchsorted); they are excluded like the reference's
+        # null-skipping aggregates skip nulls
+        mask = mask & jnp.isfinite(x)
+        B = x.shape[0]
+        sorted_x = jnp.sort(jnp.where(mask, x, jnp.inf))
+        nv = jnp.sum(mask, dtype=jnp.int64)
+        # compaction level from the SURVIVING row count (a where-filter
+        # or padding can make nv << B): level = ceil_log2(ceil(nv / k)),
+        # computed with integer bit tricks so exact powers stay exact
+        q = ((nv + k - 1) // k).astype(jnp.uint32)
+        level = jnp.where(
+            q > 1, 32 - jax.lax.clz(jnp.maximum(q - 1, 1)), 0
+        ).astype(jnp.int32)
+        stride = (jnp.int64(1) << level.astype(jnp.int64))
+        # data-derived random offset in [0, stride): stride is a power of
+        # two, so masking the avalanche hash of the valid count + first
+        # value's bits is uniform enough for the compactor offset
+        bits = jax.lax.bitcast_convert_type(sorted_x[0], jnp.uint32)
+        seed = fmix32(nv.astype(jnp.uint32) ^ bits)
+        offset = (seed.astype(jnp.int64)) & (stride - 1)
+        idx = offset + jnp.arange(k, dtype=jnp.int64) * stride
+        valid = idx < nv
+        samples = sorted_x[jnp.clip(idx, 0, B - 1)]
+        mn = jnp.min(jnp.where(mask, x, jnp.inf))
+        mx = jnp.max(jnp.where(mask, x, -jnp.inf))
+        return (
+            samples,
+            valid,
+            nv,
+            mn,
+            mx,
+            level,
+        )
+
+    def host_init() -> KLLSketchState:
+        return KLLSketchState(params)
+
+    def host_fold(acc: KLLSketchState, out) -> KLLSketchState:
+        samples, valid, nv, mn, mx, level = out
+        acc.add_pre_compacted(
+            np.asarray(samples)[np.asarray(valid)],
+            int(level),
+            int(nv),
+            float(mn),
+            float(mx),
+        )
+        return acc
+
+    return ScanOps(
+        init,
+        update,
+        KLLSketchState.merge,
+        host_init=host_init,
+        host_fold=host_fold,
+    )
+
+
+class _KLLBase(ScanShareableAnalyzer):
+    column: str
+    where: Optional[str]
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Precondition]:
+        return [has_column(self.column), is_numeric(self.column)]
+
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        _, reqs = _compile_where(self.where, dataset)
+        return [
+            ColumnRequest(self.column, "values"),
+            ColumnRequest(self.column, "mask"),
+        ] + reqs
+
+
+@dataclass(frozen=True)
+class KLLSketch(_KLLBase):
+    """Full KLL sketch metric (reference: analyzers/KLLSketch.scala)."""
+
+    column: str
+    params: KLLParameters = field(default_factory=KLLParameters)
+    where: Optional[str] = None
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        return _make_kll_ops(self, dataset, self.params)
+
+    def compute_metric_from_state(self, state) -> Metric:
+        if state is None or state.is_empty:
+            return self.to_failure_metric(
+                EmptyStateException("Empty state for analyzer KLLSketch.")
+            )
+        buckets = [
+            BucketValue(lo, hi, count)
+            for lo, hi, count in state.buckets(self.params.number_of_buckets)
+        ]
+        dist = BucketDistribution(
+            buckets,
+            parameters=(
+                self.params.shrinking_factor,
+                float(self.params.sketch_size),
+            ),
+            data=tuple(tuple(map(float, lv)) for lv in state.levels),
+        )
+        return KLLMetric(Entity.COLUMN, "KLL", self.instance, Success(dist))
+
+
+@dataclass(frozen=True)
+class ApproxQuantile(_KLLBase):
+    """Single approximate quantile (reference: ApproxQuantile.scala)."""
+
+    column: str
+    quantile: float = 0.5
+    relative_error: float = 0.01  # accepted for API parity; KLL governs
+    where: Optional[str] = None
+    params: KLLParameters = field(default_factory=KLLParameters)
+
+    def preconditions(self) -> List[Precondition]:
+        def quantile_in_range(schema):
+            if not (0.0 <= self.quantile <= 1.0):
+                from deequ_tpu.analyzers.base import (
+                    IllegalAnalyzerParameterException,
+                )
+
+                raise IllegalAnalyzerParameterException(
+                    f"quantile must be in [0, 1], got {self.quantile}"
+                )
+
+        return super().preconditions() + [quantile_in_range]
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        return _make_kll_ops(self, dataset, self.params)
+
+    def compute_metric_from_state(self, state) -> DoubleMetric:
+        if state is None or state.is_empty:
+            return self.to_failure_metric(
+                EmptyStateException("Empty state for analyzer ApproxQuantile.")
+            )
+        result = state.quantile(self.quantile)
+        if math.isnan(result):
+            return self.to_failure_metric(
+                EmptyStateException(
+                    "ApproxQuantile sketch holds no samples."
+                )
+            )
+        return DoubleMetric.success(
+            self.entity, "ApproxQuantile", self.instance, result
+        )
+
+
+@dataclass(frozen=True)
+class ApproxQuantiles(_KLLBase):
+    """Several quantiles from ONE sketch (reference: ApproxQuantiles.scala)."""
+
+    column: str
+    quantiles: Tuple[float, ...] = (0.25, 0.5, 0.75)
+    where: Optional[str] = None
+    params: KLLParameters = field(default_factory=KLLParameters)
+
+    def __post_init__(self):
+        object.__setattr__(self, "quantiles", tuple(self.quantiles))
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        return _make_kll_ops(self, dataset, self.params)
+
+    def compute_metric_from_state(self, state) -> Metric:
+        if state is None or state.is_empty:
+            return self.to_failure_metric(
+                EmptyStateException(
+                    "Empty state for analyzer ApproxQuantiles."
+                )
+            )
+        values = {
+            str(q): state.quantile(q) for q in self.quantiles
+        }
+        return KeyedDoubleMetric(
+            Entity.COLUMN, "ApproxQuantiles", self.instance, Success(values)
+        )

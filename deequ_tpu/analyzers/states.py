@@ -216,18 +216,9 @@ class ApproxCountDistinctState(NamedTuple):
         return ApproxCountDistinctState(jnp.maximum(a.registers, b.registers))
 
 
-class KLLState(NamedTuple):
-    """Fixed-shape KLL-style sketch: per-level item buffers + fill counts
-    plus exact min/max/count. Merge happens host-side via compaction (see
-    deequ_tpu.sketches.kll); on-device per-batch pre-compaction keeps
-    shapes static so the hot path jits (SURVEY.md §7 hard part #2)."""
-
-    items: jnp.ndarray  # float64[levels, capacity]
-    fills: jnp.ndarray  # int32[levels]
-    count: jnp.ndarray  # int64 scalar
-    min_value: jnp.ndarray  # float64
-    max_value: jnp.ndarray  # float64
-
+# (The KLL sketch state is host-side — deequ_tpu.sketches.kll.KLLSketchState —
+# because its compaction is data-dependent; its device-side per-batch
+# pre-compaction output is transient and never persisted.)
 
 # Registry used by state serde (deequ_tpu.io.state_provider).
 STATE_TYPES: Dict[str, Type] = {
@@ -244,6 +235,5 @@ STATE_TYPES: Dict[str, Type] = {
         SumPairState,
         DataTypeHistogram,
         ApproxCountDistinctState,
-        KLLState,
     )
 }

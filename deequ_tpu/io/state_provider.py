@@ -27,6 +27,7 @@ import numpy as np
 from deequ_tpu.analyzers.base import Analyzer
 from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
 from deequ_tpu.analyzers.states import STATE_TYPES
+from deequ_tpu.sketches.kll import KLLSketchState
 
 
 class StateLoader:
@@ -100,6 +101,12 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 counts=state.counts,
                 num_rows=np.int64(state.num_rows),
             )
+        elif isinstance(state, KLLSketchState):
+            np.savez(
+                filename,
+                __type__=np.asarray("KLLSketchState"),
+                **state.to_arrays(),
+            )
         elif hasattr(state, "_fields"):  # NamedTuple state
             payload = {
                 field: _to_host(getattr(state, field))
@@ -129,6 +136,8 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 return FrequenciesAndNumRows(
                     columns, keys, data["counts"], int(data["num_rows"])
                 )
+            if type_name == "KLLSketchState":
+                return KLLSketchState.from_arrays(data)
             cls = STATE_TYPES.get(type_name)
             if cls is None:
                 raise TypeError(f"unknown persisted state type {type_name}")

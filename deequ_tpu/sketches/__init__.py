@@ -1,0 +1,3 @@
+from deequ_tpu.sketches.kll import KLLParameters, KLLSketchState
+
+__all__ = ["KLLParameters", "KLLSketchState"]

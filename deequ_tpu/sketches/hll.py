@@ -1,0 +1,111 @@
+"""HyperLogLog primitives for the device pass.
+
+Reference: ``analyzers/catalyst/StatefulHyperloglogPlus`` (SURVEY.md
+§2.3): HLL++ registers as packed words updated per row inside Tungsten;
+merge = word-wise max. TPU design (per SURVEY's table): registers are an
+int32[m] device vector; the per-batch update is hash -> leading-zero
+count -> scatter-max; the merge is an elementwise max (a ``lax.max``
+all-reduce across the mesh / across persisted states).
+
+Hashing is built from 32-bit lanes ONLY — the TPU has no native 64-bit
+integer path (XLA's x64 rewriter refuses u64 bitcasts), and 32-bit
+murmur-style mixing maps perfectly onto the VPU:
+
+- numerics canonicalize to a (float32, float32 residual) pair — ~48 bits
+  of value information, identical for int and float columns of equal
+  value (required by incremental merges across datasets);
+- the pair's bit patterns mix through murmur3's 32-bit finalizer into
+  two independent 32-bit hashes: h1 supplies the register index (top
+  P bits), h2 supplies the leading-zero rank;
+- strings hash host-side ONCE per dictionary entry (blake2b-8, split
+  into two u32 words) into device lookup tables gathered by code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 14  # precision: m = 2^14 registers => ~0.8% relative error
+M = 1 << P
+
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+_GOLDEN = np.uint32(0x9E3779B9)
+
+
+def fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finalizer (avalanche); h: uint32 array."""
+    h = h ^ (h >> 16)
+    h = h * _C1
+    h = h ^ (h >> 13)
+    h = h * _C2
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_pair_numeric(
+    values: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Canonicalize numerics and produce two independent u32 hashes.
+
+    Canonical form: hi = float32(x), lo = float32(x - hi) — exact for
+    integers up to ~2^48 and collision-free for typical float data, and
+    IDENTICAL whether the column arrived as int32/int64/float32/float64.
+    """
+    as_f64 = values.astype(jnp.float64) + 0.0  # -0.0 -> +0.0
+    hi = as_f64.astype(jnp.float32)
+    lo = (as_f64 - hi.astype(jnp.float64)).astype(jnp.float32) + 0.0
+    hi_bits = jax.lax.bitcast_convert_type(hi, jnp.uint32)
+    lo_bits = jax.lax.bitcast_convert_type(lo, jnp.uint32)
+    h1 = fmix32(lo_bits ^ fmix32(hi_bits ^ _GOLDEN))
+    h2 = fmix32(hi_bits ^ fmix32(lo_bits ^ _C1))
+    return h1, h2
+
+
+def dictionary_hash_pairs(
+    dictionary: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable (u32, u32) hash per dictionary entry (host-side, once)."""
+    n = max(len(dictionary), 1)
+    h1 = np.zeros(n, dtype=np.uint32)
+    h2 = np.zeros(n, dtype=np.uint32)
+    for i, value in enumerate(dictionary):
+        if value is None:
+            continue
+        digest = hashlib.blake2b(
+            str(value).encode("utf-8"), digest_size=8
+        ).digest()
+        words = np.frombuffer(digest, dtype=np.uint32)
+        h1[i], h2[i] = words[0], words[1]
+    return h1, h2
+
+
+def registers_from_hash_pair(
+    h1: jnp.ndarray, h2: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """One batch of hash pairs -> int32[M] register vector (scatter-max).
+
+    rho comes from h2's leading zeros (1..33) — supporting max register
+    rank 33, ample for cardinalities far beyond 2^40."""
+    idx = (h1 >> np.uint32(32 - P)).astype(jnp.int32)
+    rho = jnp.minimum(jax.lax.clz(h2) + 1, 33).astype(jnp.int32)
+    rho = jnp.where(mask, rho, 0)
+    idx = jnp.where(mask, idx, 0)
+    return jnp.zeros(M, dtype=jnp.int32).at[idx].max(rho)
+
+
+def estimate(registers: np.ndarray) -> float:
+    """Standard HLL estimator with linear counting for the small range."""
+    registers = np.asarray(registers, dtype=np.float64)
+    m = float(M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    raw = alpha * m * m / np.sum(np.exp2(-registers))
+    zeros = float(np.count_nonzero(registers == 0))
+    if raw <= 2.5 * m and zeros > 0:
+        return float(m * np.log(m / zeros))
+    return float(raw)

@@ -1,0 +1,283 @@
+"""KLL quantile sketch with a TPU-friendly split of labor.
+
+Reference: the reference implements the KLL compactor hierarchy as
+``QuantileNonSample.scala`` + ``KLLSketchSerializer`` (SURVEY.md §2.3):
+fixed-capacity compactors; merge = concatenate + recompress. Its per-row
+update is a Tungsten aggregate. A literal port would be scalar,
+data-dependent control flow — hostile to XLA (SURVEY.md §7 hard part #2).
+
+TPU design: a sorted batch of B items, strided by 2^l with a random
+offset, IS l rounds of KLL compaction applied at once. So the device
+kernel (inside the shared fused scan) sorts the batch and emits k
+strided samples at static level l = ceil(log2(B / k)) — fixed shapes,
+jit-friendly, and only k floats cross the device->host boundary per
+batch. The host keeps the compactor hierarchy (tiny arrays) and merges
+batch contributions by concatenate + recompress, which is also the
+cross-dataset/incremental merge.
+
+Rank-error behavior matches the KLL family: O(1/k) with capacity
+shrinking by ``shrinking_factor`` per level down from the top.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_SKETCH_SIZE = 2048
+DEFAULT_SHRINKING_FACTOR = 0.64
+MIN_CAPACITY = 8
+
+
+@dataclass(frozen=True)
+class KLLParameters:
+    """Reference: KLLParameters(sketchSize, shrinkingFactor, maxDetailBins)."""
+
+    sketch_size: int = DEFAULT_SKETCH_SIZE
+    shrinking_factor: float = DEFAULT_SHRINKING_FACTOR
+    number_of_buckets: int = 100
+
+
+class KLLSketchState:
+    """Host-side compactor hierarchy. ``levels[i]`` holds unweighted items
+    of weight 2^i. Mergeable (concat + recompress) => a monoid, so it
+    rides run_on_aggregated_states like every other state."""
+
+    def __init__(
+        self,
+        params: KLLParameters = KLLParameters(),
+        levels: Optional[List[np.ndarray]] = None,
+        count: int = 0,
+        min_value: float = math.inf,
+        max_value: float = -math.inf,
+        seed: int = 0x5EED,
+    ):
+        self.params = params
+        self.levels: List[np.ndarray] = (
+            [np.asarray(lv, dtype=np.float64) for lv in levels]
+            if levels
+            else [np.empty(0, dtype=np.float64)]
+        )
+        self.count = int(count)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+        self._rng = np.random.default_rng(seed)
+
+    # -- capacities -----------------------------------------------------
+
+    def _capacity(self, level: int) -> int:
+        """Top level has capacity k; lower levels shrink geometrically."""
+        height = len(self.levels)
+        depth = height - 1 - level
+        cap = int(
+            math.ceil(
+                self.params.sketch_size
+                * (self.params.shrinking_factor ** depth)
+            )
+        )
+        return max(MIN_CAPACITY, cap)
+
+    # -- update ---------------------------------------------------------
+
+    def update_batch(self, values: np.ndarray) -> None:
+        values = np.asarray(values, dtype=np.float64)
+        values = values[np.isfinite(values)]
+        if values.size == 0:
+            return
+        self.count += int(values.size)
+        self.min_value = min(self.min_value, float(values.min()))
+        self.max_value = max(self.max_value, float(values.max()))
+        self.levels[0] = np.concatenate([self.levels[0], values])
+        self._compress()
+
+    def add_pre_compacted(
+        self,
+        values: np.ndarray,
+        level: int,
+        count: int,
+        min_value: float,
+        max_value: float,
+    ) -> None:
+        """Insert items already compacted to ``level`` (the device batch
+        kernel's output); weights 2^level."""
+        values = np.asarray(values, dtype=np.float64)
+        values = values[np.isfinite(values)]  # sentinel/NaN safety net
+        while len(self.levels) <= level:
+            self.levels.append(np.empty(0, dtype=np.float64))
+        if values.size:
+            self.levels[level] = np.concatenate(
+                [self.levels[level], values]
+            )
+        self.count += int(count)
+        if count > 0:
+            self.min_value = min(self.min_value, float(min_value))
+            self.max_value = max(self.max_value, float(max_value))
+        self._compress()
+
+    def _compress(self) -> None:
+        level = 0
+        while level < len(self.levels):
+            if self.levels[level].size > self._capacity(level):
+                self._compact_level(level)
+            level += 1
+
+    def _compact_level(self, level: int) -> None:
+        buffer = np.sort(self.levels[level])
+        if buffer.size % 2 == 1:
+            # keep one random end unpaired at this level
+            if self._rng.integers(0, 2):
+                leftover, buffer = buffer[-1:], buffer[:-1]
+            else:
+                leftover, buffer = buffer[:1], buffer[1:]
+        else:
+            leftover = np.empty(0, dtype=np.float64)
+        offset = int(self._rng.integers(0, 2))
+        promoted = buffer[offset::2]
+        self.levels[level] = np.asarray(leftover, dtype=np.float64)
+        if level + 1 >= len(self.levels):
+            self.levels.append(np.empty(0, dtype=np.float64))
+        self.levels[level + 1] = np.concatenate(
+            [self.levels[level + 1], promoted]
+        )
+
+    # -- merge (monoid) -------------------------------------------------
+
+    @staticmethod
+    def merge(a: "KLLSketchState", b: "KLLSketchState") -> "KLLSketchState":
+        if a.params != b.params:
+            raise ValueError("cannot merge KLL sketches with different params")
+        height = max(len(a.levels), len(b.levels))
+        levels = []
+        for i in range(height):
+            la = a.levels[i] if i < len(a.levels) else np.empty(0)
+            lb = b.levels[i] if i < len(b.levels) else np.empty(0)
+            levels.append(
+                np.concatenate(
+                    [np.asarray(la, np.float64), np.asarray(lb, np.float64)]
+                )
+            )
+        out = KLLSketchState(
+            a.params,
+            levels,
+            a.count + b.count,
+            min(a.min_value, b.min_value),
+            max(a.max_value, b.max_value),
+        )
+        out._compress()
+        return out
+
+    # -- queries --------------------------------------------------------
+
+    def _weighted_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        values = []
+        weights = []
+        for level, buf in enumerate(self.levels):
+            if buf.size:
+                values.append(buf)
+                weights.append(np.full(buf.size, 2.0 ** level))
+        if not values:
+            return np.empty(0), np.empty(0)
+        v = np.concatenate(values)
+        w = np.concatenate(weights)
+        order = np.argsort(v, kind="stable")
+        return v[order], w[order]
+
+    @property
+    def is_empty(self) -> bool:
+        return self.count == 0
+
+    def quantile(self, q: float) -> float:
+        """Smallest sketched value whose cumulative weight >= q * total."""
+        v, w = self._weighted_items()
+        if v.size == 0:
+            return math.nan
+        cum = np.cumsum(w)
+        target = q * cum[-1]
+        idx = int(np.searchsorted(cum, target, side="left"))
+        idx = min(idx, v.size - 1)
+        return float(v[idx])
+
+    def quantiles(self, qs: Sequence[float]) -> List[float]:
+        return [self.quantile(q) for q in qs]
+
+    def rank(self, x: float) -> float:
+        """Estimated number of items <= x."""
+        v, w = self._weighted_items()
+        if v.size == 0:
+            return 0.0
+        idx = np.searchsorted(v, x, side="right")
+        return float(np.sum(w[:idx]))
+
+    def cdf(self, x: float) -> float:
+        total = self.count
+        return self.rank(x) / total if total else math.nan
+
+    def buckets(self, number_of_buckets: int) -> List[Tuple[float, float, int]]:
+        """Equi-width bucketing (low, high, count) over [min, max]."""
+        if self.is_empty:
+            return []
+        lo, hi = self.min_value, self.max_value
+        if hi == lo:
+            return [(lo, hi, self.count)]
+        edges = np.linspace(lo, hi, number_of_buckets + 1)
+        ranks = [self.rank(edge) for edge in edges]
+        ranks[0] = 0.0
+        ranks[-1] = float(self.count)
+        out = []
+        for i in range(number_of_buckets):
+            out.append(
+                (
+                    float(edges[i]),
+                    float(edges[i + 1]),
+                    int(round(ranks[i + 1] - ranks[i])),
+                )
+            )
+        return out
+
+    # -- serde ----------------------------------------------------------
+
+    def to_arrays(self) -> Dict[str, np.ndarray]:
+        flat = np.concatenate(
+            [np.asarray(lv, np.float64) for lv in self.levels]
+        ) if self.levels else np.empty(0)
+        sizes = np.asarray([lv.size for lv in self.levels], dtype=np.int64)
+        return {
+            "items": flat,
+            "level_sizes": sizes,
+            "count": np.int64(self.count),
+            "min_value": np.float64(self.min_value),
+            "max_value": np.float64(self.max_value),
+            "params": np.asarray(
+                [
+                    self.params.sketch_size,
+                    self.params.shrinking_factor,
+                    self.params.number_of_buckets,
+                ],
+                dtype=np.float64,
+            ),
+        }
+
+    @staticmethod
+    def from_arrays(data) -> "KLLSketchState":
+        params = KLLParameters(
+            int(data["params"][0]),
+            float(data["params"][1]),
+            int(data["params"][2]),
+        )
+        sizes = data["level_sizes"]
+        flat = data["items"]
+        levels = []
+        pos = 0
+        for size in sizes:
+            levels.append(np.asarray(flat[pos : pos + int(size)]))
+            pos += int(size)
+        return KLLSketchState(
+            params,
+            levels,
+            int(data["count"]),
+            float(data["min_value"]),
+            float(data["max_value"]),
+        )

@@ -1,23 +1,29 @@
-"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax import,
-so every 'distributed' behavior is tested on a fake mesh with no real
-cluster — the TPU transfer of the reference's local-Spark fixture
-(SURVEY.md §4: SparkContextSpec -> virtual-device mesh)."""
+"""Test env: force JAX onto CPU with 8 virtual devices, so every
+'distributed' behavior is tested on a fake mesh with no real cluster —
+the TPU transfer of the reference's local-Spark fixture (SURVEY.md §4:
+SparkContextSpec -> virtual-device mesh).
+
+NOTE: this image pre-imports jax (sitecustomize on PYTHONPATH) with
+JAX_PLATFORMS=axon, so the env var is already consumed; the supported
+override point is jax.config BEFORE any backend is initialized."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture
 def cpu_mesh():
-    import jax
-    from jax.sharding import Mesh
     import numpy as np
+    from jax.sharding import Mesh
 
     devices = np.array(jax.devices("cpu")[:8])
     return Mesh(devices, ("dp",))

@@ -1,0 +1,222 @@
+"""Sketch accuracy + merge + serde tests (reference shape:
+KLLDistanceTest / KLLSketchTest / HLL accuracy tests — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    KLLSketch,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.engine import AnalysisEngine
+from deequ_tpu.io import FileSystemStateProvider, InMemoryStateProvider
+from deequ_tpu.sketches.kll import KLLParameters, KLLSketchState
+
+
+def value(metric):
+    assert metric.value.is_success, f"metric failed: {metric.value}"
+    return metric.value.get()
+
+
+class TestHLL:
+    def test_exact_small(self):
+        ds = Dataset.from_pydict({"x": [1, 2, 3, 2, 1]})
+        est = value(ApproxCountDistinct("x").calculate(ds))
+        assert est == pytest.approx(3.0, rel=0.01)
+
+    def test_accuracy_numeric(self):
+        rng = np.random.default_rng(1)
+        n_distinct = 80_000
+        vals = rng.integers(0, n_distinct, 300_000)
+        true = len(np.unique(vals))
+        ds = Dataset.from_pydict({"x": vals})
+        est = value(ApproxCountDistinct("x").calculate(ds))
+        assert est == pytest.approx(true, rel=0.03)
+
+    def test_strings(self):
+        ds = Dataset.from_pydict(
+            {"s": [f"user-{i % 500}" for i in range(5_000)]}
+        )
+        est = value(ApproxCountDistinct("s").calculate(ds))
+        assert est == pytest.approx(500, rel=0.03)
+
+    def test_nulls_ignored(self):
+        import pyarrow as pa
+
+        ds = Dataset.from_arrow(
+            pa.table({"x": pa.array([1.0, None, 2.0, None], pa.float64())})
+        )
+        assert value(ApproxCountDistinct("x").calculate(ds)) == pytest.approx(
+            2.0, rel=0.01
+        )
+
+    def test_merge_equals_union(self):
+        rng = np.random.default_rng(2)
+        a = rng.integers(0, 10_000, 50_000)
+        b = rng.integers(5_000, 15_000, 50_000)
+        analyzer = ApproxCountDistinct("x")
+        providers = []
+        for part in (a, b):
+            p = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict({"x": part}), [analyzer],
+                save_states_with=p,
+            )
+            providers.append(p)
+        merged = AnalysisRunner.run_on_aggregated_states(
+            Dataset.from_pydict({"x": a[:1]}).schema, [analyzer], providers
+        )
+        union = AnalysisRunner.do_analysis_run(
+            Dataset.from_pydict({"x": np.concatenate([a, b])}), [analyzer]
+        )
+        # register-max merge must give the IDENTICAL estimate
+        assert value(merged.metric(analyzer)) == value(union.metric(analyzer))
+
+    def test_int_float_hash_consistency(self):
+        """int64 and float64 columns with equal values agree (the
+        canonicalized hash), required for cross-dataset merges."""
+        ints = Dataset.from_pydict({"x": np.arange(1000, dtype=np.int64)})
+        floats = Dataset.from_pydict({"x": np.arange(1000, dtype=np.float64)})
+        ei = value(ApproxCountDistinct("x").calculate(ints))
+        ef = value(ApproxCountDistinct("x").calculate(floats))
+        assert ei == ef
+
+
+class TestKLL:
+    def test_exact_small(self):
+        ds = Dataset.from_pydict({"x": list(range(1, 101))})
+        q = value(ApproxQuantile("x", 0.5).calculate(ds))
+        assert q == pytest.approx(50.0, abs=1.0)
+
+    def test_rank_error_uniform(self):
+        rng = np.random.default_rng(3)
+        vals = rng.uniform(0, 1, 500_000)
+        ds = Dataset.from_pydict({"x": vals})
+        engine = AnalysisEngine(batch_size=65_536)
+        analyzer = ApproxQuantiles("x", (0.1, 0.25, 0.5, 0.75, 0.9))
+        ctx = AnalysisRunner.do_analysis_run(ds, [analyzer], engine=engine)
+        result = value(ctx.metric(analyzer))
+        for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+            # uniform[0,1]: value at quantile q is ~q; rank error < 1%
+            assert result[str(q)] == pytest.approx(q, abs=0.01)
+
+    def test_merge_across_partitions(self):
+        rng = np.random.default_rng(4)
+        vals = rng.normal(0, 1, 200_000)
+        analyzer = ApproxQuantile("x", 0.5)
+        providers = []
+        for part in np.array_split(vals, 4):
+            p = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict({"x": part}), [analyzer],
+                save_states_with=p,
+            )
+            providers.append(p)
+        merged = AnalysisRunner.run_on_aggregated_states(
+            Dataset.from_pydict({"x": vals[:1]}).schema, [analyzer], providers
+        )
+        med = value(merged.metric(analyzer))
+        assert med == pytest.approx(np.median(vals), abs=0.02)
+
+    def test_kll_metric_buckets(self):
+        ds = Dataset.from_pydict({"x": list(range(1000))})
+        analyzer = KLLSketch("x", KLLParameters(number_of_buckets=10))
+        dist = value(analyzer.calculate(ds))
+        assert len(dist.buckets) == 10
+        assert sum(b.count for b in dist.buckets) == pytest.approx(
+            1000, abs=20
+        )
+        assert dist.buckets[0].low_value == 0.0
+        assert dist.buckets[-1].high_value == 999.0
+
+    def test_filesystem_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(5)
+        ds = Dataset.from_pydict({"x": rng.normal(0, 1, 10_000)})
+        analyzer = ApproxQuantile("x", 0.9)
+        provider = FileSystemStateProvider(str(tmp_path))
+        ctx = AnalysisRunner.do_analysis_run(
+            ds, [analyzer], save_states_with=provider
+        )
+        reloaded = AnalysisRunner.run_on_aggregated_states(
+            ds.schema, [analyzer], [FileSystemStateProvider(str(tmp_path))]
+        )
+        assert value(reloaded.metric(analyzer)) == value(ctx.metric(analyzer))
+
+    def test_nonnumeric_fails(self):
+        ds = Dataset.from_pydict({"s": ["a", "b"]})
+        metric = ApproxQuantile("s", 0.5).calculate(ds)
+        assert metric.value.is_failure
+
+    def test_bad_quantile_fails(self):
+        ds = Dataset.from_pydict({"x": [1.0, 2.0]})
+        metric = ApproxQuantile("x", 1.5).calculate(ds)
+        assert metric.value.is_failure
+
+
+class TestKLLSketchStateUnit:
+    def test_streaming_matches_exact(self):
+        rng = np.random.default_rng(6)
+        vals = rng.exponential(2.0, 100_000)
+        sk = KLLSketchState()
+        for chunk in np.array_split(vals, 37):
+            sk.update_batch(chunk)
+        assert sk.count == 100_000
+        for q in (0.05, 0.5, 0.95):
+            exact = np.quantile(vals, q)
+            # compare by rank: estimated value's true rank within 1.5%
+            est = sk.quantile(q)
+            true_rank = np.mean(vals <= est)
+            assert abs(true_rank - q) < 0.015, (q, est, exact)
+
+    def test_monoid_merge(self):
+        rng = np.random.default_rng(7)
+        a, b = rng.normal(0, 1, 50_000), rng.normal(5, 1, 50_000)
+        sa, sb = KLLSketchState(), KLLSketchState()
+        sa.update_batch(a)
+        sb.update_batch(b)
+        merged = KLLSketchState.merge(sa, sb)
+        assert merged.count == 100_000
+        both = np.concatenate([a, b])
+        est = merged.quantile(0.5)
+        assert np.mean(both <= est) == pytest.approx(0.5, abs=0.02)
+
+
+class TestKLLRegressions:
+    def test_sparse_where_filter(self):
+        """Compaction level derives from surviving rows, not batch size
+        (a heavy where-filter must not starve the sketch)."""
+        rng = np.random.default_rng(8)
+        n = 200_000
+        ds = Dataset.from_pydict(
+            {
+                "x": rng.uniform(0, 1, n),
+                "y": (np.arange(n) % 2000 == 0).astype(np.int64),
+            }
+        )
+        analyzer = ApproxQuantile("x", 0.5, where="y = 1")
+        metric = analyzer.calculate(ds)
+        assert metric.value.is_success, metric.value
+        assert 0.3 < metric.value.get() < 0.7
+
+    def test_nan_values_excluded(self):
+        vals = np.arange(1000, dtype=np.float64)
+        vals[5] = np.nan
+        ds = Dataset.from_pydict({"x": vals})
+        metric = ApproxQuantile("x", 1.0).calculate(ds)
+        assert metric.value.is_success, metric.value
+        assert metric.value.get() == 999.0
+
+    def test_sharded_step_rejects_host_fold(self, cpu_mesh):
+        from deequ_tpu.engine import AnalysisEngine
+
+        ds = Dataset.from_pydict({"x": [1.0, 2.0]})
+        analyzer = ApproxQuantile("x", 0.5)
+        planned = [(analyzer, analyzer.make_ops(ds))]
+        with pytest.raises(ValueError, match="host-folded"):
+            AnalysisEngine(mesh=cpu_mesh).build_sharded_step(
+                ds, planned, cpu_mesh
+            )
