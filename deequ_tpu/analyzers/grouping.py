@@ -127,16 +127,35 @@ def compute_frequencies(
     plan: FrequencyPlan,
     engine: Optional[AnalysisEngine] = None,
 ) -> FrequenciesAndNumRows:
+    return compute_many_frequencies(dataset, [plan], engine)[plan]
+
+
+def compute_many_frequencies(
+    dataset: Dataset,
+    plans: Sequence[FrequencyPlan],
+    engine: Optional[AnalysisEngine] = None,
+) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
+    """ALL dense frequency plans ride ONE fused scan (each plan is just a
+    scatter-add over different codes, so K plans still cost one data
+    pass — the profiler's pass-3 histogram explosion collapses into a
+    single job, SURVEY.md §7 hard part #6). Plans whose joint key space
+    exceeds the dense cap fall back to Arrow's host group_by."""
     engine = engine or AnalysisEngine()
-    columns = list(plan.columns)
-    dictionaries = [dataset.dictionary(c) for c in columns]
-    sizes = [len(d) + 1 for d in dictionaries]  # +1: the null slot
-    joint = 1
-    for s in sizes:
-        joint *= s
-    if joint <= MAX_DENSE_JOINT:
-        return _device_frequencies(dataset, plan, dictionaries, sizes, engine)
-    return _arrow_frequencies(dataset, plan)
+    dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]] = []
+    results: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
+    for plan in plans:
+        dictionaries = [dataset.dictionary(c) for c in plan.columns]
+        sizes = [len(d) + 1 for d in dictionaries]  # +1: the null slot
+        joint = 1
+        for s in sizes:
+            joint *= s
+        if joint <= MAX_DENSE_JOINT:
+            dense.append((plan, dictionaries, sizes))
+        else:
+            results[plan] = _arrow_frequencies(dataset, plan)
+    if dense:
+        results.update(_device_frequencies_shared(dataset, dense, engine))
+    return results
 
 
 def _where_mask_full(dataset: Dataset, where: Optional[str]) -> Optional[np.ndarray]:
@@ -150,13 +169,15 @@ def _where_mask_full(dataset: Dataset, where: Optional[str]) -> Optional[np.ndar
     return np.asarray(jax.device_get(pred.complies(batch))).astype(bool)
 
 
-def _device_frequencies(
+def _make_dense_ops(
     dataset: Dataset,
     plan: FrequencyPlan,
-    dictionaries: List[np.ndarray],
     sizes: List[int],
-    engine: AnalysisEngine,
-) -> FrequenciesAndNumRows:
+):
+    """(requests, ScanOps) for one dense frequency plan; the ops' state is
+    (dense int64 count vector, kept-row count)."""
+    from deequ_tpu.analyzers.base import ScanOps
+
     columns = list(plan.columns)
     where_fn = None
     requests = [ColumnRequest(c, "codes") for c in columns] + [
@@ -200,22 +221,18 @@ def _device_frequencies(
         )[:joint].astype(jnp.int64)
         return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
 
-    class _FreqAnalyzer:
-        """Adapter so the frequency pass rides the shared scan engine."""
-
-        def device_requests(self, ds):
-            return requests
-
-    from deequ_tpu.analyzers.base import ScanOps
-
     ops = ScanOps(init, update, lambda a, b: (a[0] + b[0], a[1] + b[1]))
-    (counts, num_rows), = [
-        s
-        for s in engine.run_scan(dataset, [(_FreqAnalyzer(), ops)])  # type: ignore[list-item]
-    ]
-    counts = np.asarray(counts)
-    num_rows = int(num_rows)
+    return requests, ops
 
+
+def _decode_dense(
+    plan: FrequencyPlan,
+    dictionaries: List[np.ndarray],
+    sizes: List[int],
+    counts: np.ndarray,
+    num_rows: int,
+) -> FrequenciesAndNumRows:
+    columns = list(plan.columns)
     observed = np.nonzero(counts)[0]
     key_arr = np.empty((len(observed), len(columns)), dtype=object)
     remaining = observed.copy()
@@ -223,11 +240,42 @@ def _device_frequencies(
         slot = remaining % sizes[j]
         remaining = remaining // sizes[j]
         dictionary = dictionaries[j]
-        for i, s in enumerate(slot):
-            key_arr[i, j] = None if s == 0 else dictionary[s - 1]
+        decoded = np.empty(len(slot), dtype=object)
+        non_null = slot > 0
+        if non_null.any():
+            decoded[non_null] = dictionary[slot[non_null] - 1]
+        decoded[~non_null] = None
+        key_arr[:, j] = decoded
     return FrequenciesAndNumRows(
         tuple(columns), key_arr, counts[observed], num_rows
     )
+
+
+def _device_frequencies_shared(
+    dataset: Dataset,
+    dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]],
+    engine: AnalysisEngine,
+) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
+    class _FreqAnalyzer:
+        """Adapter so frequency passes ride the shared scan engine."""
+
+        def __init__(self, requests):
+            self._requests = requests
+
+        def device_requests(self, ds):
+            return self._requests
+
+    planned = []
+    for plan, dictionaries, sizes in dense:
+        requests, ops = _make_dense_ops(dataset, plan, sizes)
+        planned.append((_FreqAnalyzer(requests), ops))
+    states = engine.run_scan(dataset, planned)  # type: ignore[arg-type]
+    out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
+    for (plan, dictionaries, sizes), (counts, num_rows) in zip(dense, states):
+        out[plan] = _decode_dense(
+            plan, dictionaries, sizes, np.asarray(counts), int(num_rows)
+        )
+    return out
 
 
 def _arrow_frequencies(
@@ -274,13 +322,19 @@ def run_grouping_analyzers(
         )
         by_plan.setdefault(plan, []).append(analyzer)
 
+    try:
+        all_frequencies = compute_many_frequencies(
+            dataset, list(by_plan.keys()), engine
+        )
+    except Exception as exc:  # noqa: BLE001
+        return {
+            analyzer: analyzer.to_failure_metric(exc)
+            for group in by_plan.values()
+            for analyzer in group
+        }
+
     for plan, group in by_plan.items():
-        try:
-            frequencies = compute_frequencies(dataset, plan, engine)
-        except Exception as exc:  # noqa: BLE001
-            for analyzer in group:
-                metrics[analyzer] = analyzer.to_failure_metric(exc)
-            continue
+        frequencies = all_frequencies[plan]
         for analyzer in group:
             try:
                 state = frequencies

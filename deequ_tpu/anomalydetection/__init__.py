@@ -1,0 +1,37 @@
+from deequ_tpu.anomalydetection.base import (
+    Anomaly,
+    AnomalyDetectionStrategy,
+    AnomalyDetector,
+    DataPoint,
+    DetectionResult,
+)
+from deequ_tpu.anomalydetection.seasonal import (
+    HoltWinters,
+    MetricInterval,
+    SeriesSeasonality,
+)
+from deequ_tpu.anomalydetection.strategies import (
+    AbsoluteChangeStrategy,
+    BatchNormalStrategy,
+    OnlineNormalStrategy,
+    RelativeRateOfChangeStrategy,
+    SimpleThresholdStrategy,
+)
+from deequ_tpu.anomalydetection.wiring import AnomalyCheckConfig
+
+__all__ = [
+    "AbsoluteChangeStrategy",
+    "Anomaly",
+    "AnomalyCheckConfig",
+    "AnomalyDetectionStrategy",
+    "AnomalyDetector",
+    "BatchNormalStrategy",
+    "DataPoint",
+    "DetectionResult",
+    "HoltWinters",
+    "MetricInterval",
+    "OnlineNormalStrategy",
+    "RelativeRateOfChangeStrategy",
+    "SeriesSeasonality",
+    "SimpleThresholdStrategy",
+]

@@ -1,0 +1,87 @@
+"""Anomaly-check wiring into VerificationSuite.
+
+Reference: ``VerificationRunBuilder.addAnomalyCheck`` (SURVEY.md §3.5):
+synthesize a Check whose constraint assertion loads the metric history
+from the repository and asks the strategy whether the new point is
+anomalous. Driver-only; no data access beyond the metric itself.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.anomalydetection.base import (
+    AnomalyDetectionStrategy,
+    AnomalyDetector,
+    DataPoint,
+)
+from deequ_tpu.checks.check import Check, CheckLevel
+from deequ_tpu.constraints.constraint import (
+    AnalysisBasedConstraint,
+    NamedConstraint,
+)
+
+
+@dataclass
+class AnomalyCheckConfig:
+    level: CheckLevel = CheckLevel.WARNING
+    description: str = "Anomaly check"
+    with_tag_values: Dict[str, str] = field(default_factory=dict)
+    after_date: Optional[int] = None
+    before_date: Optional[int] = None
+
+
+def build_anomaly_check(
+    repository,
+    strategy: AnomalyDetectionStrategy,
+    analyzer: Analyzer,
+    config: AnomalyCheckConfig,
+    current_key=None,
+) -> Check:
+    def assertion(metric_value: float) -> bool:
+        loader = repository.load().for_analyzers([analyzer])
+        if config.with_tag_values:
+            loader = loader.with_tag_values(config.with_tag_values)
+        if config.after_date is not None:
+            loader = loader.after(config.after_date)
+        if config.before_date is not None:
+            loader = loader.before(config.before_date)
+        now = (
+            current_key.dataset_date
+            if current_key is not None
+            else _max_time(loader) + 1
+        )
+        history = []
+        for result in loader.get():
+            if (
+                current_key is not None
+                and result.result_key.dataset_date >= now
+            ):
+                continue  # the in-flight run's own (or newer) points
+            metric = result.analyzer_context.metric(analyzer)
+            if metric is not None and metric.value.is_success:
+                history.append(
+                    DataPoint(
+                        result.result_key.dataset_date,
+                        float(metric.value.get()),
+                    )
+                )
+        detection = AnomalyDetector(strategy).is_new_point_anomalous(
+            history, DataPoint(now, float(metric_value))
+        )
+        return not detection.is_anomalous
+
+    constraint = NamedConstraint(
+        AnalysisBasedConstraint(analyzer, assertion),
+        f"AnomalyConstraint({analyzer.name}({analyzer.instance}))",
+    )
+    return Check(config.level, config.description).add_constraint(constraint)
+
+
+def _max_time(loader) -> int:
+    results = loader.get()
+    return max(
+        (r.result_key.dataset_date for r in results), default=0
+    )

@@ -1,0 +1,19 @@
+from deequ_tpu.profiles.profiler import (
+    ColumnProfiler,
+    ColumnProfiles,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.profiles.runner import (
+    ColumnProfilerRunBuilder,
+    ColumnProfilerRunner,
+)
+
+__all__ = [
+    "ColumnProfiler",
+    "ColumnProfilerRunBuilder",
+    "ColumnProfilerRunner",
+    "ColumnProfiles",
+    "NumericColumnProfile",
+    "StandardColumnProfile",
+]

@@ -1,0 +1,271 @@
+"""Column profiling: the 3-pass pipeline.
+
+Reference: ``src/main/scala/com/amazon/deequ/profiles/`` (SURVEY.md
+§2.5, §3.3):
+
+- PASS 1 — one fused scan over ALL columns: Completeness,
+  ApproxCountDistinct, DataType (string columns);
+- type inference promotes numeric-looking string columns;
+- PASS 2 — second fused scan over numeric columns: Mean, Maximum,
+  Minimum, StandardDeviation, Sum (+ KLL percentiles when KLL profiling
+  is on);
+- PASS 3 — histograms for columns whose approx distinct count is below
+  the low-cardinality threshold (default 120). In deequ_tpu all pass-3
+  histograms share ONE scan (compute_many_frequencies), defusing the
+  reference's pass-3 job explosion (SURVEY.md §7 hard part #6).
+
+This is the north-star benchmark workload (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxCountDistinct,
+    ApproxQuantiles,
+    Completeness,
+    DataType,
+    Histogram,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.datatype import inferred_kind
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind
+from deequ_tpu.engine.scan import AnalysisEngine
+from deequ_tpu.metrics.distribution import Distribution
+from deequ_tpu.metrics.kll import BucketDistribution
+from deequ_tpu.sketches.kll import KLLParameters
+
+DEFAULT_LOW_CARDINALITY_THRESHOLD = 120
+_PERCENTILES = tuple(round(q / 100.0, 2) for q in range(1, 100))
+
+
+@dataclass
+class StandardColumnProfile:
+    column: str
+    completeness: float
+    approximate_num_distinct_values: float
+    data_type: Kind
+    is_data_type_inferred: bool
+    type_counts: Dict[str, int] = field(default_factory=dict)
+    histogram: Optional[Distribution] = None
+
+
+@dataclass
+class NumericColumnProfile(StandardColumnProfile):
+    mean: Optional[float] = None
+    maximum: Optional[float] = None
+    minimum: Optional[float] = None
+    sum: Optional[float] = None
+    std_dev: Optional[float] = None
+    approx_percentiles: Optional[List[float]] = None
+    kll: Optional[BucketDistribution] = None
+
+
+@dataclass
+class ColumnProfiles:
+    profiles: Dict[str, StandardColumnProfile]
+    num_records: int
+
+    def __getitem__(self, column: str) -> StandardColumnProfile:
+        return self.profiles[column]
+
+
+class ColumnProfiler:
+    @staticmethod
+    def profile(
+        data: Dataset,
+        restrict_to_columns: Optional[Sequence[str]] = None,
+        low_cardinality_histogram_threshold: int = DEFAULT_LOW_CARDINALITY_THRESHOLD,
+        kll_profiling: bool = False,
+        kll_parameters: Optional[KLLParameters] = None,
+        engine: Optional[AnalysisEngine] = None,
+    ) -> ColumnProfiles:
+        engine = engine or AnalysisEngine()
+        columns = list(restrict_to_columns or data.schema.column_names)
+        for c in columns:
+            if not data.schema.has_column(c):
+                raise KeyError(f"unknown column {c!r}")
+
+        # ---- PASS 1: generic stats, one fused scan -------------------
+        pass1: List = [Size()]
+        for c in columns:
+            pass1.append(Completeness(c))
+            pass1.append(ApproxCountDistinct(c))
+            if data.schema.kind_of(c) == Kind.STRING:
+                pass1.append(DataType(c))
+        ctx1 = AnalysisRunner.do_analysis_run(data, pass1, engine=engine)
+
+        num_records = int(ctx1.metric(Size()).value.get_or_else(0.0))
+        completeness: Dict[str, float] = {}
+        approx_distinct: Dict[str, float] = {}
+        kinds: Dict[str, Kind] = {}
+        inferred: Dict[str, bool] = {}
+        type_counts: Dict[str, Dict[str, int]] = {}
+        for c in columns:
+            completeness[c] = float(
+                ctx1.metric(Completeness(c)).value.get_or_else(0.0)
+            )
+            approx_distinct[c] = float(
+                ctx1.metric(ApproxCountDistinct(c)).value.get_or_else(0.0)
+            )
+            schema_kind = data.schema.kind_of(c)
+            if schema_kind == Kind.STRING:
+                metric = ctx1.metric(DataType(c))
+                if metric is not None and metric.value.is_success:
+                    kinds[c] = inferred_kind(metric)
+                    inferred[c] = True
+                    type_counts[c] = {
+                        k: v.absolute
+                        for k, v in metric.value.get().values.items()
+                    }
+                else:
+                    kinds[c] = Kind.STRING
+                    inferred[c] = False
+                    type_counts[c] = {}
+            else:
+                kinds[c] = schema_kind
+                inferred[c] = False
+                type_counts[c] = {}
+
+        # ---- cast promoted string columns for pass 2 ------------------
+        numeric_native = [
+            c for c in columns if data.schema.kind_of(c).is_numeric
+        ]
+        numeric_promoted = [
+            c
+            for c in columns
+            if data.schema.kind_of(c) == Kind.STRING
+            and kinds[c] in (Kind.INTEGRAL, Kind.FRACTIONAL)
+        ]
+        promoted_data = (
+            _cast_string_columns(data, numeric_promoted)
+            if numeric_promoted
+            else None
+        )
+
+        # ---- PASS 2: numeric stats, one fused scan per dataset -------
+        def numeric_analyzers(cols: Sequence[str]) -> List:
+            out: List = []
+            for c in cols:
+                out += [
+                    Mean(c), Maximum(c), Minimum(c), Sum(c),
+                    StandardDeviation(c),
+                ]
+                if kll_profiling:
+                    params = kll_parameters or KLLParameters()
+                    out.append(KLLSketch(c, params))
+                    out.append(
+                        ApproxQuantiles(c, _PERCENTILES, params=params)
+                    )
+            return out
+
+        ctx2 = AnalysisRunner.do_analysis_run(
+            data, numeric_analyzers(numeric_native), engine=engine
+        )
+        if promoted_data is not None:
+            ctx2 = ctx2 + AnalysisRunner.do_analysis_run(
+                promoted_data, numeric_analyzers(numeric_promoted),
+                engine=engine,
+            )
+
+        # ---- PASS 3: histograms for low-cardinality columns ----------
+        # (ALL histograms share one scan via compute_many_frequencies)
+        histogram_columns = [
+            c
+            for c in columns
+            if approx_distinct[c] <= low_cardinality_histogram_threshold
+            and kinds[c] in (Kind.STRING, Kind.BOOLEAN, Kind.INTEGRAL)
+        ]
+        ctx3 = AnalysisRunner.do_analysis_run(
+            data, [Histogram(c) for c in histogram_columns], engine=engine
+        )
+
+        # ---- assemble -------------------------------------------------
+        profiles: Dict[str, StandardColumnProfile] = {}
+        for c in columns:
+            histogram = None
+            if c in histogram_columns:
+                metric = ctx3.metric(Histogram(c))
+                if metric is not None and metric.value.is_success:
+                    histogram = metric.value.get()
+            base = dict(
+                column=c,
+                completeness=completeness[c],
+                approximate_num_distinct_values=approx_distinct[c],
+                data_type=kinds[c],
+                is_data_type_inferred=inferred[c],
+                type_counts=type_counts[c],
+                histogram=histogram,
+            )
+            if kinds[c].is_numeric:
+                def metric_value(analyzer):
+                    m = ctx2.metric(analyzer)
+                    if m is None or m.value.is_failure:
+                        return None
+                    return m.value.get()
+
+                target = c
+                percentiles = None
+                kll_dist = None
+                if kll_profiling:
+                    params = kll_parameters or KLLParameters()
+                    quantiles = metric_value(
+                        ApproxQuantiles(target, _PERCENTILES, params=params)
+                    )
+                    if quantiles is not None:
+                        percentiles = [
+                            quantiles[str(q)] for q in _PERCENTILES
+                        ]
+                    kll_dist = metric_value(KLLSketch(target, params))
+                profiles[c] = NumericColumnProfile(
+                    **base,
+                    mean=metric_value(Mean(target)),
+                    maximum=metric_value(Maximum(target)),
+                    minimum=metric_value(Minimum(target)),
+                    sum=metric_value(Sum(target)),
+                    std_dev=metric_value(StandardDeviation(target)),
+                    approx_percentiles=percentiles,
+                    kll=kll_dist,
+                )
+            else:
+                profiles[c] = StandardColumnProfile(**base)
+        return ColumnProfiles(profiles, num_records)
+
+
+def _cast_string_columns(data: Dataset, columns: Sequence[str]) -> Dataset:
+    """Numeric view of numeric-looking string columns: parse the (small)
+    dictionary host-side, then gather by code — the string data itself is
+    never re-scanned (SURVEY.md §3.3 'cast a projected copy')."""
+    arrays = {}
+    for c in columns:
+        dictionary = data.dictionary(c)
+        parsed = np.full(len(dictionary) + 1, np.nan)
+        for i, v in enumerate(dictionary):
+            if v is None:
+                continue
+            try:
+                parsed[i] = float(str(v).strip())
+            except ValueError:
+                parsed[i] = np.nan
+        codes = data.materialize(ColumnRequest(c, "codes"))
+        values = parsed[np.where(codes < 0, len(dictionary), codes)]
+        arrays[c] = pa.array(
+            values, pa.float64(), mask=np.isnan(values)
+        )  # unparseable/null -> SQL NULL
+    table = pa.table(
+        {c: arrays[c] for c in columns}
+    )
+    out = Dataset.from_arrow(table)
+    return out

@@ -5,9 +5,11 @@ from deequ_tpu.repository.base import (
     MetricsRepositoryMultipleResultsLoader,
     ResultKey,
 )
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
 
 __all__ = [
     "AnalysisResult",
+    "FileSystemMetricsRepository",
     "InMemoryMetricsRepository",
     "MetricsRepository",
     "MetricsRepositoryMultipleResultsLoader",

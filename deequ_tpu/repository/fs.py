@@ -1,0 +1,71 @@
+"""Filesystem metrics repository: one JSON file of all results.
+
+Reference: ``repository/fs/FileSystemMetricsRepository.scala`` (SURVEY.md
+§2.5) — JSON file on local/HDFS/S3 via the Hadoop FS API; here any
+mounted filesystem path. Concurrent writers are serialized by an
+advisory in-process lock; the file is rewritten atomically.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import List, Optional
+
+from deequ_tpu.repository import serde
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+
+
+class FileSystemMetricsRepository(MetricsRepository):
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def _read_all(self) -> List[AnalysisResult]:
+        if not os.path.exists(self._path):
+            return []
+        with open(self._path) as fh:
+            text = fh.read()
+        if not text.strip():
+            return []
+        return serde.deserialize(text)
+
+    def _write_all(self, results: List[AnalysisResult]) -> None:
+        directory = os.path.dirname(os.path.abspath(self._path))
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(serde.serialize(results))
+            os.replace(tmp, self._path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def save(self, result: AnalysisResult) -> None:
+        with self._lock:
+            results = [
+                r
+                for r in self._read_all()
+                if r.result_key != result.result_key
+            ]
+            results.append(result)
+            self._write_all(results)
+
+    def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:
+        with self._lock:
+            for result in self._read_all():
+                if result.result_key == key:
+                    return result
+        return None
+
+    def load(self) -> MetricsRepositoryMultipleResultsLoader:
+        with self._lock:
+            return MetricsRepositoryMultipleResultsLoader(self._read_all())

@@ -1,0 +1,158 @@
+"""ConstraintSuggestionRunner: profile -> rules -> suggestions, with
+optional train/test evaluation.
+
+Reference: ``suggestions/ConstraintSuggestionRunner.scala`` (SURVEY.md
+§2.5, §3.4): profile the (train split of the) data, apply every rule to
+every column profile, and optionally verify the suggested constraints on
+a holdout split (``useTrainTestSplitWithTestsetRatio``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deequ_tpu.checks.check import Check, CheckLevel
+from deequ_tpu.data.table import Dataset
+from deequ_tpu.engine.scan import AnalysisEngine
+from deequ_tpu.profiles.profiler import ColumnProfiler, ColumnProfiles
+from deequ_tpu.sketches.kll import KLLParameters
+from deequ_tpu.suggestions.rules import ConstraintRule, ConstraintSuggestion
+from deequ_tpu.verification.suite import VerificationResult, VerificationSuite
+
+
+@dataclass
+class ConstraintSuggestionResult:
+    column_profiles: ColumnProfiles
+    constraint_suggestions: Dict[str, List[ConstraintSuggestion]] = field(
+        default_factory=dict
+    )
+    verification_result: Optional[VerificationResult] = None
+
+    def all_suggestions(self) -> List[ConstraintSuggestion]:
+        return [
+            s for group in self.constraint_suggestions.values() for s in group
+        ]
+
+
+class ConstraintSuggestionRunner:
+    def on_data(self, data: Dataset) -> "ConstraintSuggestionRunBuilder":
+        return ConstraintSuggestionRunBuilder(data)
+
+
+class ConstraintSuggestionRunBuilder:
+    def __init__(self, data: Dataset):
+        self._data = data
+        self._rules: List[ConstraintRule] = []
+        self._restrict_to_columns: Optional[Sequence[str]] = None
+        self._low_cardinality_threshold: Optional[int] = None
+        self._kll_profiling = False
+        self._kll_parameters: Optional[KLLParameters] = None
+        self._testset_ratio: Optional[float] = None
+        self._testset_seed: int = 42
+        self._engine: Optional[AnalysisEngine] = None
+
+    def add_constraint_rule(
+        self, rule: ConstraintRule
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._rules.append(rule)
+        return self
+
+    def add_constraint_rules(
+        self, rules: Sequence[ConstraintRule]
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._rules.extend(rules)
+        return self
+
+    def restrict_to_columns(
+        self, columns: Sequence[str]
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._restrict_to_columns = list(columns)
+        return self
+
+    def with_low_cardinality_histogram_threshold(
+        self, threshold: int
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._low_cardinality_threshold = threshold
+        return self
+
+    def with_kll_profiling(
+        self, kll_parameters: Optional[KLLParameters] = None
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._kll_profiling = True
+        self._kll_parameters = kll_parameters
+        return self
+
+    def use_train_test_split_with_testset_ratio(
+        self, testset_ratio: float, seed: int = 42
+    ) -> "ConstraintSuggestionRunBuilder":
+        if not 0.0 < testset_ratio < 1.0:
+            raise ValueError("testset_ratio must be in (0, 1)")
+        self._testset_ratio = testset_ratio
+        self._testset_seed = seed
+        return self
+
+    def with_engine(
+        self, engine: AnalysisEngine
+    ) -> "ConstraintSuggestionRunBuilder":
+        self._engine = engine
+        return self
+
+    def run(self) -> ConstraintSuggestionResult:
+        train, test = self._split()
+        from deequ_tpu.profiles.profiler import (
+            DEFAULT_LOW_CARDINALITY_THRESHOLD,
+        )
+
+        profiles = ColumnProfiler.profile(
+            train,
+            restrict_to_columns=self._restrict_to_columns,
+            low_cardinality_histogram_threshold=(
+                self._low_cardinality_threshold
+                or DEFAULT_LOW_CARDINALITY_THRESHOLD
+            ),
+            kll_profiling=self._kll_profiling,
+            kll_parameters=self._kll_parameters,
+            engine=self._engine,
+        )
+        suggestions: Dict[str, List[ConstraintSuggestion]] = {}
+        for column, profile in profiles.profiles.items():
+            for rule in self._rules:
+                try:
+                    if rule.should_be_applied(profile, profiles.num_records):
+                        suggestions.setdefault(column, []).append(
+                            rule.candidate(profile, profiles.num_records)
+                        )
+                except Exception:  # noqa: BLE001 — a rule must not kill the run
+                    continue
+
+        verification_result = None
+        if test is not None and any(suggestions.values()):
+            check = Check(
+                CheckLevel.WARNING, "Suggested constraints (holdout eval)"
+            )
+            for group in suggestions.values():
+                for suggestion in group:
+                    check = suggestion.apply_to_check(check)
+            verification_result = (
+                VerificationSuite()
+                .on_data(test)
+                .add_check(check)
+                .run()
+            )
+        return ConstraintSuggestionResult(
+            profiles, suggestions, verification_result
+        )
+
+    def _split(self):
+        if self._testset_ratio is None:
+            return self._data, None
+        rng = np.random.default_rng(self._testset_seed)
+        n = self._data.num_rows
+        test_mask = rng.random(n) < self._testset_ratio
+        return (
+            self._data.filter_rows(~test_mask),
+            self._data.filter_rows(test_mask),
+        )
