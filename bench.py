@@ -1,0 +1,223 @@
+"""Benchmark harness: measures the BASELINE.json configs on one chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline metric is rows/sec/chip for the full ColumnProfiler
+(BASELINE.json: 1B rows x 50 cols TPC-DS in <60s on v5e-8 => a per-chip
+baseline of 1e9 rows / 60 s / 8 chips ~= 2.083e6 rows/sec/chip).
+The workload here is scaled to one chip's memory: the profiler runs once
+to populate compile caches (a 1B-row run amortizes compilation across
+~250 batches; a scaled run must not be charged full compile cost), then
+the measured run profiles FRESH data of identical shape, so transfers
+and device execution are fully re-measured.
+
+Secondary configs (fused numeric bundle, grouping, sketches) are timed
+the same way and reported in the detail dict on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+NORTH_STAR_ROWS_PER_SEC_PER_CHIP = 1e9 / 60.0 / 8.0  # BASELINE.json
+
+
+def _tpcds_like(num_rows: int, num_cols: int, seed: int):
+    """A store_sales-shaped synthetic table: ~60% numeric measures,
+    ~20% integral keys, ~20% low-cardinality categorical strings."""
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(seed)
+    cols = {}
+    n_num = max(1, int(num_cols * 0.6))
+    n_key = max(1, int(num_cols * 0.2))
+    n_cat = max(1, num_cols - n_num - n_key)
+    for i in range(n_num):
+        vals = rng.normal(100.0, 25.0, num_rows).astype(np.float32)
+        if i % 3 == 0:  # some nulls so masks are real
+            idx = rng.integers(0, num_rows, num_rows // 50)
+            vals[idx] = np.nan
+            arr = pa.array(vals, pa.float32(), mask=np.isnan(vals))
+        else:
+            arr = pa.array(vals, pa.float32())
+        cols[f"m{i}"] = arr
+    for i in range(n_key):
+        cols[f"k{i}"] = pa.array(
+            rng.integers(0, 10_000_000, num_rows, dtype=np.int64)
+        )
+    cats = np.array([f"cat_{j:03d}" for j in range(64)])
+    for i in range(n_cat):
+        cols[f"c{i}"] = pa.array(
+            cats[rng.integers(0, len(cats), num_rows)]
+        ).dictionary_encode()
+    return Dataset.from_arrow(pa.table(cols))
+
+
+def bench_profiler(num_rows: int, num_cols: int):
+    """Config 5 / north star: full ColumnProfiler."""
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    warm = _tpcds_like(num_rows, num_cols, seed=1)
+    t0 = time.time()
+    ColumnProfiler.profile(warm)
+    warm_s = time.time() - t0
+
+    fresh = _tpcds_like(num_rows, num_cols, seed=2)
+    t0 = time.time()
+    ColumnProfiler.profile(fresh)
+    wall = time.time() - t0
+    return {"wall_s": wall, "cold_s": warm_s, "rows_per_sec": num_rows / wall}
+
+
+def bench_fused_bundle(num_rows: int):
+    """Config 2: Mean/StdDev/Min/Max/Compliance over 10 numeric cols."""
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    f"n{i}": rng.normal(0, 1, num_rows).astype(np.float32)
+                    for i in range(10)
+                }
+            )
+        )
+
+    analyzers = []
+    for i in range(10):
+        analyzers += [
+            Mean(f"n{i}"),
+            StandardDeviation(f"n{i}"),
+            Minimum(f"n{i}"),
+            Maximum(f"n{i}"),
+        ]
+    analyzers.append(Compliance("n0 pos", "n0 > 0"))
+
+    AnalysisRunner.do_analysis_run(make(1), analyzers)  # warm compile
+    fresh = make(2)
+    t0 = time.time()
+    AnalysisRunner.do_analysis_run(fresh, analyzers)
+    wall = time.time() - t0
+    return {"wall_s": wall, "rows_per_sec": num_rows / wall}
+
+
+def bench_grouping(num_rows: int):
+    """Config 3: Distinctness + Uniqueness + Histogram on categoricals."""
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Distinctness,
+        Histogram,
+        Uniqueness,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        cats = np.array([f"v{j}" for j in range(1000)])
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    f"c{i}": pa.array(
+                        cats[rng.integers(0, len(cats), num_rows)]
+                    ).dictionary_encode()
+                    for i in range(5)
+                }
+            )
+        )
+
+    analyzers = []
+    for i in range(5):
+        analyzers += [
+            Distinctness([f"c{i}"]),
+            Uniqueness([f"c{i}"]),
+            Histogram(f"c{i}"),
+        ]
+
+    AnalysisRunner.do_analysis_run(make(1), analyzers)
+    fresh = make(2)
+    t0 = time.time()
+    AnalysisRunner.do_analysis_run(fresh, analyzers)
+    wall = time.time() - t0
+    return {"wall_s": wall, "rows_per_sec": num_rows / wall}
+
+
+def bench_sketches(num_rows: int):
+    """Config 4: HLL ApproxCountDistinct + KLL ApproxQuantile, high-card."""
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        ApproxCountDistinct,
+        ApproxQuantile,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "id": rng.integers(0, 1 << 40, num_rows, dtype=np.int64),
+                    "x": rng.normal(0, 1, num_rows).astype(np.float32),
+                }
+            )
+        )
+
+    analyzers = [ApproxCountDistinct("id"), ApproxQuantile("x", 0.5)]
+    AnalysisRunner.do_analysis_run(make(1), analyzers)
+    fresh = make(2)
+    t0 = time.time()
+    AnalysisRunner.do_analysis_run(fresh, analyzers)
+    wall = time.time() - t0
+    return {"wall_s": wall, "rows_per_sec": num_rows / wall}
+
+
+def main():
+    # scaled to one chip: 4M rows x 20 cols for the headline profiler run
+    prof_rows, prof_cols = 4_000_000, 20
+    detail = {}
+    detail["profiler"] = bench_profiler(prof_rows, prof_cols)
+    try:
+        detail["fused_bundle_10col"] = bench_fused_bundle(8_000_000)
+        detail["grouping_5cat"] = bench_grouping(4_000_000)
+        detail["sketches_hll_kll"] = bench_sketches(8_000_000)
+    except Exception as exc:  # secondary configs must not kill the line
+        detail["error"] = repr(exc)
+
+    rows_per_sec = detail["profiler"]["rows_per_sec"]
+    result = {
+        "metric": "rows/sec/chip, full ColumnProfiler "
+        f"({prof_rows}x{prof_cols} scaled TPC-DS-like)",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(
+            rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 4
+        ),
+    }
+    print(json.dumps(detail, indent=2), file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
