@@ -44,6 +44,12 @@ _F64 = jnp.float64
 _I64 = jnp.int64
 
 
+def _acc_float():
+    from deequ_tpu import config
+
+    return config.options().accumulation_float()
+
+
 def _compile_where(
     where: Optional[str], dataset: Dataset
 ) -> Tuple[Optional[Callable], List[ColumnRequest]]:
@@ -68,12 +74,49 @@ def _col_mask(batch, column: str, where_fn) -> jnp.ndarray:
     return mask
 
 
-def _msum(x, mask, dtype=_F64):
-    return jnp.sum(jnp.where(mask, x, 0).astype(dtype))
+# TPU dtype discipline (VERDICT.md weak #4): float64 is software-emulated
+# on TPU, so per-element work runs in the column's NATIVE dtype (XLA's
+# tree reduction keeps f32 summation error ~ulp*log n) and only the
+# per-batch *scalar* results are cast into the accumulation dtype —
+# a handful of emulated scalar ops per batch instead of an emulated
+# elementwise pass over millions of rows.
+
+
+def _msum(x, mask):
+    """Masked sum: elementwise in native dtype, scalar in accumulation
+    dtype. Integral columns always widen per element to f64 (exactness
+    over speed — int overflow/rounding must not depend on the float
+    accumulation knob); only the scalar result follows the knob."""
+    acc = _acc_float()
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.sum(jnp.where(mask, x, jnp.zeros((), x.dtype))).astype(acc)
+    return jnp.sum(jnp.where(mask, x, 0).astype(_F64)).astype(acc)
+
+
+def _mmin(x, mask):
+    """Masked min: elementwise native, scalar always f64 — min/max has
+    no accumulation-error concern, and f64 is exact for f32 inputs and
+    ints up to 2^53 (the reference's double semantics). A fixed result
+    dtype also keeps the lax.scan carry stable across column types."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        neutral = jnp.array(jnp.inf, x.dtype)
+    else:
+        neutral = jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
+    return jnp.min(jnp.where(mask, x, neutral)).astype(_F64)
+
+
+def _mmax(x, mask):
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        neutral = jnp.array(-jnp.inf, x.dtype)
+    else:
+        neutral = jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+    return jnp.max(jnp.where(mask, x, neutral)).astype(_F64)
 
 
 def _mcount(mask) -> jnp.ndarray:
-    return jnp.sum(mask, dtype=_I64)
+    # batch counts fit i32 (batches are <2^31 rows); the cross-batch
+    # carry is an exact i64 scalar add
+    return jnp.sum(mask, dtype=jnp.int32).astype(_I64)
 
 
 # --------------------------------------------------------------------------
@@ -378,9 +421,10 @@ class Minimum(_NumericColumnAnalyzer):
 
         def update(state: S.MinState, batch) -> S.MinState:
             mask = _col_mask(batch, col, where_fn)
-            masked = jnp.where(mask, batch[f"{col}::values"], jnp.inf)
             return S.MinState(
-                jnp.minimum(state.min_value, jnp.min(masked.astype(_F64))),
+                jnp.minimum(
+                    state.min_value, _mmin(batch[f"{col}::values"], mask)
+                ),
                 state.count + _mcount(mask),
             )
 
@@ -409,9 +453,10 @@ class Maximum(_NumericColumnAnalyzer):
 
         def update(state: S.MaxState, batch) -> S.MaxState:
             mask = _col_mask(batch, col, where_fn)
-            masked = jnp.where(mask, batch[f"{col}::values"], -jnp.inf)
             return S.MaxState(
-                jnp.maximum(state.max_value, jnp.max(masked.astype(_F64))),
+                jnp.maximum(
+                    state.max_value, _mmax(batch[f"{col}::values"], mask)
+                ),
                 state.count + _mcount(mask),
             )
 
@@ -460,11 +505,10 @@ class MinLength(_LengthAnalyzer):
 
         def update(state: S.MinState, batch) -> S.MinState:
             mask = _col_mask(batch, col, where_fn)
-            masked = jnp.where(
-                mask, batch[f"{col}::lengths"].astype(_F64), jnp.inf
-            )
             return S.MinState(
-                jnp.minimum(state.min_value, jnp.min(masked)),
+                jnp.minimum(
+                    state.min_value, _mmin(batch[f"{col}::lengths"], mask)
+                ),
                 state.count + _mcount(mask),
             )
 
@@ -493,11 +537,10 @@ class MaxLength(_LengthAnalyzer):
 
         def update(state: S.MaxState, batch) -> S.MaxState:
             mask = _col_mask(batch, col, where_fn)
-            masked = jnp.where(
-                mask, batch[f"{col}::lengths"].astype(_F64), -jnp.inf
-            )
             return S.MaxState(
-                jnp.maximum(state.max_value, jnp.max(masked)),
+                jnp.maximum(
+                    state.max_value, _mmax(batch[f"{col}::lengths"], mask)
+                ),
                 state.count + _mcount(mask),
             )
 
@@ -532,11 +575,19 @@ class StandardDeviation(_NumericColumnAnalyzer):
             state: S.StandardDeviationState, batch
         ) -> S.StandardDeviationState:
             mask = _col_mask(batch, col, where_fn)
-            x = batch[f"{col}::values"].astype(_F64)
-            nb = jnp.sum(mask, dtype=_F64)
+            x = batch[f"{col}::values"]
+            acc = _acc_float()
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                # integral columns widen to f64 regardless of the knob
+                # (f32 would corrupt large ints, e.g. int64 timestamps)
+                x = x.astype(_F64)
+            nb = _mcount(mask).astype(acc)
             safe_nb = jnp.maximum(nb, 1.0)
             mean_b = _msum(x, mask) / safe_nb
-            m2_b = jnp.sum(jnp.where(mask, (x - mean_b) ** 2, 0.0))
+            # second moment: elementwise in the column dtype around the
+            # batch mean; only the scalar widens to the accumulation dtype
+            dx = jnp.where(mask, x - mean_b.astype(x.dtype), 0)
+            m2_b = jnp.sum(dx * dx).astype(acc)
             batch_state = S.StandardDeviationState(
                 nb, jnp.where(nb > 0, mean_b, 0.0), jnp.where(nb > 0, m2_b, 0.0)
             )
@@ -605,21 +656,26 @@ class Correlation(ScanShareableAnalyzer):
         def update(state: S.CorrelationState, batch) -> S.CorrelationState:
             mask = batch[f"{ca}::mask"] & batch[f"{cb}::mask"]
             mask = mask & _row_mask(batch, where_fn)
-            x = batch[f"{ca}::values"].astype(_F64)
-            y = batch[f"{cb}::values"].astype(_F64)
-            nb = jnp.sum(mask, dtype=_F64)
+            x = batch[f"{ca}::values"]
+            y = batch[f"{cb}::values"]
+            acc = _acc_float()
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(_F64)
+            if not jnp.issubdtype(y.dtype, jnp.floating):
+                y = y.astype(_F64)
+            nb = _mcount(mask).astype(acc)
             safe_nb = jnp.maximum(nb, 1.0)
             x_avg = _msum(x, mask) / safe_nb
             y_avg = _msum(y, mask) / safe_nb
-            dx = jnp.where(mask, x - x_avg, 0.0)
-            dy = jnp.where(mask, y - y_avg, 0.0)
+            dx = jnp.where(mask, x - x_avg.astype(x.dtype), 0)
+            dy = jnp.where(mask, y - y_avg.astype(y.dtype), 0)
             batch_state = S.CorrelationState(
                 nb,
                 jnp.where(nb > 0, x_avg, 0.0),
                 jnp.where(nb > 0, y_avg, 0.0),
-                jnp.sum(dx * dy),
-                jnp.sum(dx * dx),
-                jnp.sum(dy * dy),
+                jnp.sum(dx * dy).astype(acc),
+                jnp.sum(dx * dx).astype(acc),
+                jnp.sum(dy * dy).astype(acc),
             )
             return S.CorrelationState.merge(state, batch_state)
 

@@ -21,12 +21,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _facc(value: float = 0.0):
+    """Scalar in the configured accumulation float dtype (see
+    deequ_tpu.config: f64 default; f32 avoids emulated f64 scalar ops on
+    TPU at the cost of cross-batch rounding)."""
+    from deequ_tpu import config
+
+    return config.options().accumulation_float()(value)
+
+
+def _iacc(value: int = 0):
+    """Count scalar — always int64: counts are exact row-count semantics
+    regardless of the float accumulation knob (i64 scalar adds are a few
+    emulated ops per batch, never per element)."""
+    return np.int64(value)
+
+
 class NumMatches(NamedTuple):
     num_matches: jnp.ndarray  # int64 scalar
 
     @staticmethod
     def identity() -> "NumMatches":
-        return NumMatches(np.int64(0))
+        return NumMatches(_iacc(0))
 
     @staticmethod
     def merge(a: "NumMatches", b: "NumMatches") -> "NumMatches":
@@ -39,7 +55,7 @@ class NumMatchesAndCount(NamedTuple):
 
     @staticmethod
     def identity() -> "NumMatchesAndCount":
-        return NumMatchesAndCount(np.int64(0), np.int64(0))
+        return NumMatchesAndCount(_iacc(0), _iacc(0))
 
     @staticmethod
     def merge(
@@ -60,7 +76,7 @@ class SumState(NamedTuple):
 
     @staticmethod
     def identity() -> "SumState":
-        return SumState(np.float64(0.0), np.int64(0))
+        return SumState(_facc(0.0), _iacc(0))
 
     @staticmethod
     def merge(a: "SumState", b: "SumState") -> "SumState":
@@ -73,7 +89,7 @@ class MeanState(NamedTuple):
 
     @staticmethod
     def identity() -> "MeanState":
-        return MeanState(np.float64(0.0), np.int64(0))
+        return MeanState(_facc(0.0), _iacc(0))
 
     @staticmethod
     def merge(a: "MeanState", b: "MeanState") -> "MeanState":
@@ -86,7 +102,9 @@ class MinState(NamedTuple):
 
     @staticmethod
     def identity() -> "MinState":
-        return MinState(np.float64(np.inf), np.int64(0))
+        # always f64: min/max carries no accumulation error (see
+        # basic._mmin) and must not round large ints
+        return MinState(np.float64(np.inf), _iacc(0))
 
     @staticmethod
     def merge(a: "MinState", b: "MinState") -> "MinState":
@@ -99,7 +117,7 @@ class MaxState(NamedTuple):
 
     @staticmethod
     def identity() -> "MaxState":
-        return MaxState(np.float64(-np.inf), np.int64(0))
+        return MaxState(np.float64(-np.inf), _iacc(0))
 
     @staticmethod
     def merge(a: "MaxState", b: "MaxState") -> "MaxState":
@@ -115,9 +133,7 @@ class StandardDeviationState(NamedTuple):
 
     @staticmethod
     def identity() -> "StandardDeviationState":
-        return StandardDeviationState(
-            np.float64(0.0), np.float64(0.0), np.float64(0.0)
-        )
+        return StandardDeviationState(_facc(0.0), _facc(0.0), _facc(0.0))
 
     @staticmethod
     def merge(
@@ -143,7 +159,7 @@ class CorrelationState(NamedTuple):
 
     @staticmethod
     def identity() -> "CorrelationState":
-        z = np.float64(0.0)
+        z = _facc(0.0)
         return CorrelationState(z, z, z, z, z, z)
 
     @staticmethod
@@ -170,7 +186,7 @@ class SumPairState(NamedTuple):
 
     @staticmethod
     def identity() -> "SumPairState":
-        return SumPairState(np.float64(0.0), np.float64(0.0), np.int64(0))
+        return SumPairState(_facc(0.0), _facc(0.0), _iacc(0))
 
     @staticmethod
     def merge(a: "SumPairState", b: "SumPairState") -> "SumPairState":

@@ -1,0 +1,115 @@
+"""Engine configuration.
+
+The reference has no global config — everything is per-run builder
+options (SURVEY.md §5.6) — but the north star asks for an engine
+selection flag (the ``deequ.engine=tpu`` analog) and the TPU build needs
+a handful of hardware-shaping knobs that have no Spark equivalent:
+
+- ``accumulation_dtype`` — dtype of scalar *float* state accumulators.
+  On TPU, float64 is software-emulated; the hot path therefore does
+  per-element work in the column's native dtype and only casts the
+  per-batch *scalar* reduction results into the accumulation dtype, so
+  "float64" costs a few emulated scalar ops per batch instead of an
+  emulated elementwise pass (VERDICT.md weak #4). Counts are ALWAYS
+  exact int64, and integral columns always widen per element to f64 —
+  the knob never changes integer semantics.
+- ``device_cache_bytes`` — budget for keeping device-resident columns.
+  Host->device bandwidth is the bottleneck (on this image the chip sits
+  behind a ~100 MB/s tunnel); the multi-pass profiler re-reads the same
+  columns, so columns are transferred once and cached on device.
+- ``synthesize_all_true_masks`` — columns with no nulls get their
+  validity mask created ON device (jnp.ones) instead of shipping
+  num_rows bytes over the wire.
+- ``compilation_cache_dir`` — persistent XLA compilation cache; the
+  fused scan re-traces per run (ops are per-dataset closures) but XLA
+  compilation — the dominant cost — is reused across runs/processes.
+- ``engine`` — "tpu" (default: whatever jax.devices() provides) or
+  "cpu" (force host platform); the engine-selection flag.
+
+Configuration may be set via ``deequ_tpu.config.set_option``, the
+``configure(...)`` context manager, or ``DEEQU_TPU_*`` environment
+variables read at import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Iterator, Optional
+
+
+@dataclass
+class Options:
+    # dtype for scalar state accumulators ("float64" | "float32")
+    accumulation_dtype: str = "float64"
+    # device-resident column cache budget (bytes); 0 disables
+    device_cache_bytes: int = int(
+        os.environ.get("DEEQU_TPU_DEVICE_CACHE_BYTES", 8 << 30)
+    )
+    # synthesize masks of all-valid columns on device (skip transfer)
+    synthesize_all_true_masks: bool = True
+    # persistent XLA compilation cache directory ("" disables)
+    compilation_cache_dir: str = os.environ.get(
+        "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")
+    )
+    # engine selection: "tpu" (default jax backend) | "cpu"
+    engine: str = os.environ.get("DEEQU_TPU_ENGINE", "tpu")
+    # rows per fused-scan batch when streaming (None = engine default)
+    batch_size: Optional[int] = None
+
+    def accumulation_float(self):
+        import jax.numpy as jnp
+
+        return jnp.float64 if self.accumulation_dtype == "float64" else jnp.float32
+
+
+_lock = threading.Lock()
+_options = Options()
+_compile_cache_installed = False
+
+
+def options() -> Options:
+    return _options
+
+
+def set_option(**kwargs) -> None:
+    global _options
+    with _lock:
+        _options = replace(_options, **kwargs)
+
+
+@contextlib.contextmanager
+def configure(**kwargs) -> Iterator[Options]:
+    """Temporarily override options within a block."""
+    global _options
+    with _lock:
+        prev = _options
+        _options = replace(_options, **kwargs)
+    try:
+        yield _options
+    finally:
+        with _lock:
+            _options = prev
+
+
+def install_compilation_cache() -> None:
+    """Enable JAX's persistent compilation cache (idempotent). Called by
+    the engine on first use; makes repeated runs of structurally
+    identical fused scans skip XLA compilation entirely."""
+    global _compile_cache_installed
+    if _compile_cache_installed:
+        return
+    cache_dir = _options.compilation_cache_dir
+    if not cache_dir:
+        return
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _compile_cache_installed = True
+    except Exception:  # cache is an optimization, never fatal
+        pass

@@ -19,6 +19,8 @@ and the fused analyzer scan compiles exactly once.
 from __future__ import annotations
 
 import enum
+import weakref
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -27,6 +29,22 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 ROW_MASK = "__row_mask__"
+
+
+def _synthesized_row_mask(nb: int, batch_size: int, n: int):
+    """(nb, batch_size) bool mask of in-bounds rows, built ON device —
+    jitted so XLA fuses the iota into the comparison and only the
+    1-bit/row bool ever materializes (no wire transfer, no full-width
+    integer intermediate in HBM)."""
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        idx = jax.lax.broadcasted_iota(jnp.int64, (nb, batch_size), 0)
+        off = jax.lax.broadcasted_iota(jnp.int64, (nb, batch_size), 1)
+        return idx * batch_size + off < n
+
+    return jax.jit(build)()
 
 
 class Kind(enum.Enum):
@@ -118,6 +136,37 @@ class Dataset:
         )
         self._materialized: Dict[str, np.ndarray] = {}
         self._dictionaries: Dict[str, np.ndarray] = {}
+        # device-resident stacked batches, keyed (repr key, batch, sharding)
+        self._device_cache: Dict = {}
+        self._cache_key = id(self)
+        weakref.finalize(self, Dataset._drop_cache_key, self._cache_key)
+
+    # device-cache accounting is GLOBAL across Datasets (one chip, one
+    # HBM): LRU registry of datasets holding device-resident columns
+    _cache_registry: "OrderedDict[int, weakref.ref]" = OrderedDict()
+    _cache_bytes_by_key: Dict[int, int] = {}
+
+    @staticmethod
+    def _drop_cache_key(key: int) -> None:
+        Dataset._cache_registry.pop(key, None)
+        Dataset._cache_bytes_by_key.pop(key, None)
+
+    @staticmethod
+    def global_device_cache_bytes() -> int:
+        return sum(Dataset._cache_bytes_by_key.values())
+
+    @property
+    def _device_cache_bytes(self) -> int:
+        return Dataset._cache_bytes_by_key.get(self._cache_key, 0)
+
+    def _add_cache_bytes(self, nbytes: int) -> None:
+        Dataset._cache_bytes_by_key[self._cache_key] = (
+            self._device_cache_bytes + nbytes
+        )
+
+    def _touch_cache_registry(self) -> None:
+        Dataset._cache_registry.pop(self._cache_key, None)
+        Dataset._cache_registry[self._cache_key] = weakref.ref(self)
 
     # -- construction ---------------------------------------------------
 
@@ -262,12 +311,7 @@ class Dataset:
         if batch_size is None:
             batch_size = n if n > 0 else 1
         batch_size = max(1, batch_size)
-        # dedup requests; always provide masks for requested columns
-        keys: Dict[str, ColumnRequest] = {}
-        for r in requests:
-            keys.setdefault(r.key, r)
-            mask_req = ColumnRequest(r.column, "mask")
-            keys.setdefault(mask_req.key, mask_req)
+        keys = self._dedup_requests(requests)
         full: Dict[str, np.ndarray] = {
             k: self.materialize(r) for k, r in keys.items()
         }
@@ -300,6 +344,184 @@ class Dataset:
                     if k.endswith("::mask"):
                         batch[k] = batch[k] & row_mask
             yield batch
+
+    # -- device-resident batching (the TPU fast path) -------------------
+
+    def _is_all_valid(self, column: str) -> bool:
+        return self._table.column(column).null_count == 0
+
+    @staticmethod
+    def _dedup_requests(
+        requests: Sequence[ColumnRequest],
+    ) -> Dict[str, ColumnRequest]:
+        """Dedup requests and add a validity-mask request per column —
+        the one canonical definition the byte estimate, the resident
+        path, and the streaming path all share."""
+        keys: Dict[str, ColumnRequest] = {}
+        for r in requests:
+            keys.setdefault(r.key, r)
+            mask_req = ColumnRequest(r.column, "mask")
+            keys.setdefault(mask_req.key, mask_req)
+        return keys
+
+    def _synthesize_mask(self, req: ColumnRequest) -> bool:
+        if req.repr != "mask" or not self._is_all_valid(req.column):
+            return False
+        from deequ_tpu import config
+
+        return config.options().synthesize_all_true_masks
+
+    def _request_row_bytes(self, r: ColumnRequest) -> int:
+        """Device bytes per row for one request (0 for synthesized);
+        mirrors what materialize() actually produces, not the Arrow
+        storage width (timestamps/dates widen to int64, f16 to f32)."""
+        if r.repr == "mask":
+            return 0 if self._synthesize_mask(r) else 1
+        if r.repr in ("codes", "lengths"):
+            return 4
+        kind = self._schema.kind_of(r.column)
+        if kind in (Kind.BOOLEAN, Kind.STRING):
+            return 4
+        if kind == Kind.TIMESTAMP:
+            return 8
+        try:
+            width = max(1, self._table.column(r.column).type.bit_width // 8)
+        except (ValueError, AttributeError):
+            return 8
+        return max(width, 4)  # f16 materializes as f32
+
+    def estimated_device_bytes(
+        self, requests: Sequence[ColumnRequest], batch_size: int
+    ) -> int:
+        """Upper-bound device bytes for the resident scan path (padded
+        rows; all-valid masks cost nothing — they alias the synthesized
+        row mask)."""
+        n = self.num_rows
+        nb = max(1, -(-n // batch_size))
+        padded = nb * batch_size
+        per_row = 1  # synthesized row mask
+        for r in self._dedup_requests(requests).values():
+            per_row += self._request_row_bytes(r)
+        return padded * per_row
+
+    def _uncached_bytes(
+        self,
+        requests: Sequence[ColumnRequest],
+        batch_size: int,
+        shard_key,
+    ) -> int:
+        """Bytes this request set would ADD to the device cache (keys
+        already resident are free — the eviction test must not count
+        them, or re-scans of a cached set would evict themselves)."""
+        n = self.num_rows
+        nb = max(1, -(-n // batch_size))
+        padded = nb * batch_size
+        total = 0
+        if (ROW_MASK, batch_size, shard_key) not in self._device_cache:
+            total += padded
+        for k, r in self._dedup_requests(requests).items():
+            if self._synthesize_mask(r):
+                continue
+            if (k, batch_size, shard_key) in self._device_cache:
+                continue
+            total += padded * self._request_row_bytes(r)
+        return total
+
+    def _ensure_cache_budget(self, needed: int, budget: int) -> None:
+        """Evict device caches (other datasets first, LRU order, then
+        this one) until ``needed`` more bytes fit in ``budget``."""
+        if Dataset.global_device_cache_bytes() + needed <= budget:
+            return
+        for key in list(Dataset._cache_registry):
+            if key == self._cache_key:
+                continue
+            ref = Dataset._cache_registry[key]
+            ds = ref()
+            if ds is not None:
+                ds.clear_device_cache()
+            else:
+                Dataset._drop_cache_key(key)
+            if Dataset.global_device_cache_bytes() + needed <= budget:
+                return
+        if Dataset.global_device_cache_bytes() + needed > budget:
+            self.clear_device_cache()
+
+    def device_scan_arrays(
+        self,
+        requests: Sequence[ColumnRequest],
+        batch_size: int,
+        sharding=None,
+        budget_bytes: int = 0,
+    ) -> Dict[str, "object"]:
+        """Device-resident stacked batches for the fused ``lax.scan``
+        path: a dict of ``(num_batches, batch_size)`` jax arrays.
+
+        Each column is transferred ONCE and cached (host->device
+        bandwidth is the engine's bottleneck; the profiler's multiple
+        passes re-read the same columns). Masks of all-valid columns and
+        the row mask are synthesized on device via iota — they never
+        cross the wire. Padding rows carry mask False exactly like the
+        host path. When adding this request set would push the resident
+        total past ``budget_bytes``, the whole cache is evicted first
+        (the new set alone is known to fit — the engine checks before
+        choosing this path).
+        """
+        import jax
+
+        n = self.num_rows
+        nb = max(1, -(-n // batch_size))
+        padded = nb * batch_size
+
+        # NamedSharding hashes by value, so equal shardings share entries
+        shard_key = sharding
+
+        if budget_bytes:
+            self._ensure_cache_budget(
+                self._uncached_bytes(requests, batch_size, shard_key),
+                budget_bytes,
+            )
+        self._touch_cache_registry()
+
+        def put(host: np.ndarray):
+            if sharding is not None:
+                return jax.device_put(host, sharding)
+            return jax.device_put(host)
+        rm_key = (ROW_MASK, batch_size, shard_key)
+        if rm_key not in self._device_cache:
+            if sharding is not None:
+                idx_dtype = np.int64 if padded >= 2**31 else np.int32
+                row_mask = put(
+                    (np.arange(padded, dtype=idx_dtype) < n).reshape(
+                        nb, batch_size
+                    )
+                )
+            else:
+                row_mask = _synthesized_row_mask(nb, batch_size, n)
+            self._device_cache[rm_key] = row_mask
+            self._add_cache_bytes(padded)
+        row_mask = self._device_cache[rm_key]
+
+        out: Dict[str, object] = {ROW_MASK: row_mask}
+        for k, r in self._dedup_requests(requests).items():
+            if self._synthesize_mask(r):
+                out[k] = row_mask
+                continue
+            ck = (k, batch_size, shard_key)
+            if ck not in self._device_cache:
+                host = self.materialize(r)
+                if padded != n:
+                    host = np.concatenate(
+                        [host, np.zeros((padded - n,), dtype=host.dtype)]
+                    )
+                arr = put(host.reshape(nb, batch_size))
+                self._device_cache[ck] = arr
+                self._add_cache_bytes(host.nbytes)
+            out[k] = self._device_cache[ck]
+        return out
+
+    def clear_device_cache(self) -> None:
+        self._device_cache.clear()
+        Dataset._drop_cache_key(self._cache_key)
 
     def num_batches(self, batch_size: Optional[int] = None) -> int:
         n = self.num_rows
