@@ -234,13 +234,15 @@ class AnalysisRunner:
             if exc is not None:
                 metrics[analyzer] = analyzer.to_failure_metric(exc)
                 continue
-            states = [
-                s
-                for loader in state_loaders
-                for s in [loader.load(analyzer)]
-                if s is not None
-            ]
             try:
+                # load inside the try: a version-mismatch or corrupt
+                # state degrades to THIS analyzer's failure metric
+                states = [
+                    s
+                    for loader in state_loaders
+                    for s in [loader.load(analyzer)]
+                    if s is not None
+                ]
                 if not states:
                     metrics[analyzer] = analyzer.compute_metric_from_state(None)
                     continue

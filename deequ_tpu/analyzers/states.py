@@ -236,6 +236,17 @@ class ApproxCountDistinctState(NamedTuple):
 # because its compaction is data-dependent; its device-side per-batch
 # pre-compaction output is transient and never persisted.)
 
+# Persisted-state format versions: bump when a state's INTERPRETATION
+# changes (not just its shape), so stale states are rejected instead of
+# silently merged wrong. v2 of ApproxCountDistinctState: integral
+# columns hash the raw int64 payload (v1 float-canonicalized, colliding
+# above 2^53) — v1 registers place the same values in different
+# registers, so a v1+v2 max-merge would double-count.
+STATE_FORMAT_VERSIONS: Dict[str, int] = {
+    "ApproxCountDistinctState": 2,
+}
+
+
 # Registry used by state serde (deequ_tpu.io.state_provider).
 STATE_TYPES: Dict[str, Type] = {
     cls.__name__: cls

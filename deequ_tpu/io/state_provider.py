@@ -26,7 +26,7 @@ import numpy as np
 
 from deequ_tpu.analyzers.base import Analyzer
 from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
-from deequ_tpu.analyzers.states import STATE_TYPES
+from deequ_tpu.analyzers.states import STATE_FORMAT_VERSIONS, STATE_TYPES
 from deequ_tpu.sketches.kll import KLLSketchState
 
 
@@ -108,12 +108,16 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 **state.to_arrays(),
             )
         elif hasattr(state, "_fields"):  # NamedTuple state
+            name = type(state).__name__
             payload = {
                 field: _to_host(getattr(state, field))
                 for field in state._fields
             }
             np.savez(
-                filename, __type__=np.asarray(type(state).__name__), **payload
+                filename,
+                __type__=np.asarray(name),
+                __version__=np.int64(STATE_FORMAT_VERSIONS.get(name, 1)),
+                **payload,
             )
         else:
             raise TypeError(
@@ -141,6 +145,14 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             cls = STATE_TYPES.get(type_name)
             if cls is None:
                 raise TypeError(f"unknown persisted state type {type_name}")
+            expected = STATE_FORMAT_VERSIONS.get(type_name, 1)
+            found = int(data["__version__"]) if "__version__" in data else 1
+            if found != expected:
+                raise TypeError(
+                    f"persisted {type_name} has format v{found}, this "
+                    f"build reads v{expected} — recompute the state "
+                    "(merging across versions would be silently wrong)"
+                )
             return cls(
                 **{f: data[f] for f in cls._fields}
             )

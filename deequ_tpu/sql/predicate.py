@@ -426,9 +426,106 @@ def compile_predicate(expression: str, dataset: Dataset) -> CompiledPredicate:
         requests.append(ColumnRequest(c, "mask"))
     for col in _length_columns_of(node):
         requests.append(ColumnRequest(col, "lengths"))
+    # static type check NOW (make_ops/planning time) so a bad predicate
+    # degrades to THAT analyzer's failure metric — a raise later, inside
+    # the shared fused-scan trace, would poison every co-scheduled
+    # analyzer in the pass
+    _check_types(node, schema)
     compiled = CompiledPredicate(node, dataset, cols, requests)
     cache[expression] = compiled
     return compiled
+
+
+def _check_types(node: Node, schema) -> str:
+    """Static kind inference: returns 'string' | 'stringlit' | 'value' |
+    'null'; raises PredicateParseError on string/numeric mixes that the
+    runtime would otherwise hit mid-trace."""
+
+    def kind_of(n: Node) -> str:
+        if isinstance(n, ColumnRef):
+            return (
+                "string" if schema.kind_of(n.name) == Kind.STRING else "value"
+            )
+        if isinstance(n, StringLit):
+            return "stringlit"
+        if isinstance(n, NullLit):
+            return "null"
+        if isinstance(n, (NumberLit, BoolLit)):
+            return "value"
+        if isinstance(n, UnaryOp):
+            k = kind_of(n.operand)
+            if n.op == "NEG" and k in ("string", "stringlit"):
+                raise PredicateParseError(
+                    "negation is undefined for string operands"
+                )
+            return "value"
+        if isinstance(n, IsNull):
+            kind_of(n.operand)
+            return "value"
+        if isinstance(n, Between):
+            check_cmp(n.operand, n.low)
+            check_cmp(n.operand, n.high)
+            return "value"
+        if isinstance(n, InList):
+            base = kind_of(n.operand)
+            for item in n.items:
+                if isinstance(item, NullLit):
+                    continue
+                item_kind = kind_of(item)
+                if base == "string" and item_kind != "stringlit":
+                    raise PredicateParseError(
+                        "IN on a string column requires string literals"
+                    )
+                if base != "string" and item_kind == "stringlit":
+                    raise PredicateParseError(
+                        "IN with string literals requires a string column"
+                    )
+            return "value"
+        if isinstance(n, Like):
+            if kind_of(n.operand) != "string":
+                raise PredicateParseError("LIKE requires a string column")
+            return "value"
+        if isinstance(n, FuncCall):
+            for a in n.args:
+                kind_of(a)
+            return "value"
+        if isinstance(n, BinOp):
+            if n.op in ("AND", "OR"):
+                kind_of(n.left)
+                kind_of(n.right)
+                return "value"
+            lk, rk = kind_of(n.left), kind_of(n.right)
+            if n.op in _CMP:
+                check_kinds(lk, rk, n.op)
+                return "value"
+            # arithmetic
+            for k in (lk, rk):
+                if k in ("string", "stringlit"):
+                    raise PredicateParseError(
+                        f"arithmetic {n.op!r} is undefined for string "
+                        "operands"
+                    )
+            return "value"
+        return "value"
+
+    def check_kinds(lk: str, rk: str, op: str) -> None:
+        stringish = ("string", "stringlit")
+        if "null" in (lk, rk):
+            return
+        if (lk in stringish) != (rk in stringish):
+            raise PredicateParseError(
+                "cannot compare a string operand with a non-string "
+                "operand (dictionary codes are not values)"
+            )
+        if lk == "stringlit" and rk == "stringlit":
+            raise PredicateParseError(
+                f"comparison {op!r} of two string literals is constant"
+            )
+
+    def check_cmp(a: Node, b: Node) -> None:
+        check_kinds(kind_of(a), kind_of(b), "BETWEEN")
+
+    return kind_of(node)
 
 
 def _length_columns_of(node: Node) -> set:
@@ -469,10 +566,61 @@ def _as_bool(v: _Val) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return v.values != 0, v.valid
 
 
+_CMP = ("=", "!=", "<", "<=", ">", ">=")
+_CMP_FNS = {
+    "=": jnp.equal,
+    "!=": jnp.not_equal,
+    "<": jnp.less,
+    "<=": jnp.less_equal,
+    ">": jnp.greater,
+    ">=": jnp.greater_equal,
+}
+
+
 def _dict_lookup(dataset: Dataset, column: str, value: str) -> int:
     dictionary = dataset.dictionary(column)
     matches = np.nonzero(dictionary == value)[0]
     return int(matches[0]) if len(matches) else -2  # -2: matches nothing
+
+
+def _rank_table(
+    dictionaries: "list[np.ndarray]", extra: "list[str]"
+) -> "dict[str, int]":
+    """Lexicographic rank of every distinct string across the given
+    dictionaries (+ literals): the shared value domain that makes codes
+    from unrelated dictionaries comparable."""
+    values = set(extra)
+    for d in dictionaries:
+        values.update(str(v) for v in d if v is not None)
+    return {v: i for i, v in enumerate(sorted(values))}
+
+
+def _ranks_for(dictionary: np.ndarray, rank: "dict[str, int]") -> np.ndarray:
+    """int32 LUT code -> shared rank; one trailing slot (-1) for null
+    codes so a single clipped gather covers every code."""
+    out = np.full(len(dictionary) + 1, -1, dtype=np.int32)
+    for i, v in enumerate(dictionary):
+        if v is not None:
+            out[i] = rank[str(v)]
+    return out
+
+
+def _gather_ranks(lut: np.ndarray, codes: jnp.ndarray) -> jnp.ndarray:
+    table = jnp.asarray(lut)
+    idx = jnp.where(codes < 0, table.shape[0] - 1, codes)
+    return table[jnp.clip(idx, 0, table.shape[0] - 1)]
+
+
+def _shared_rank_luts(dataset: Dataset, col_a: str, col_b: str):
+    da, db = dataset.dictionary(col_a), dataset.dictionary(col_b)
+    rank = _rank_table([da, db], [])
+    return _ranks_for(da, rank), _ranks_for(db, rank)
+
+
+def _rank_lut_with_literal(dataset: Dataset, column: str, literal: str):
+    d = dataset.dictionary(column)
+    rank = _rank_table([d], [literal])
+    return _ranks_for(d, rank), rank[literal]
 
 
 def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
@@ -582,13 +730,16 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 # TRUE OR NULL = TRUE (valid)
                 valid = (lv & rv) | (lv & lt) | (rv & rt)
             return _Val(truth, valid, is_bool=True)
-        # comparisons involving string literals -> dictionary-code compare
-        if node.op in ("=", "!=") and (
+        # comparisons involving string literals: =/!= compare raw codes
+        # (one O(n) dictionary lookup, scalar compare); orderings need
+        # lexicographic ranks — codes are in order of appearance
+        if node.op in _CMP and (
             isinstance(node.left, StringLit) or isinstance(node.right, StringLit)
         ):
+            lit_on_right = isinstance(node.right, StringLit)
             col_node, lit = (
                 (node.left, node.right)
-                if isinstance(node.right, StringLit)
+                if lit_on_right
                 else (node.right, node.left)
             )
             base = _eval(col_node, batch, ds)
@@ -596,25 +747,46 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 raise PredicateParseError(
                     "string comparison requires a string column"
                 )
-            code = _dict_lookup(ds, base.codes_of, lit.value)
-            truth = base.values == code
-            if node.op == "!=":
-                truth = ~truth
-            return _Val(truth, base.valid, is_bool=True)
+            if node.op in ("=", "!="):
+                code = _dict_lookup(ds, base.codes_of, lit.value)
+                truth = base.values == code
+                if node.op == "!=":
+                    truth = ~truth
+                return _Val(truth, base.valid, is_bool=True)
+            ranks, lit_rank = _rank_lut_with_literal(
+                ds, base.codes_of, lit.value
+            )
+            col_ranks = _gather_ranks(ranks, base.values)
+            lv, rv = (
+                (col_ranks, lit_rank) if lit_on_right else (lit_rank, col_ranks)
+            )
+            return _Val(_CMP_FNS[node.op](lv, rv), base.valid, is_bool=True)
         lhs = _eval(node.left, batch, ds)
         rhs = _eval(node.right, batch, ds)
         valid = lhs.valid & rhs.valid
         lv, rv = lhs.values, rhs.values
-        if node.op in ("=", "!=", "<", "<=", ">", ">="):
-            fn = {
-                "=": jnp.equal,
-                "!=": jnp.not_equal,
-                "<": jnp.less,
-                "<=": jnp.less_equal,
-                ">": jnp.greater,
-                ">=": jnp.greater_equal,
-            }[node.op]
-            return _Val(fn(lv, rv), valid, is_bool=True)
+        if node.op in _CMP:
+            if lhs.codes_of is not None and rhs.codes_of is not None:
+                # two string columns: dictionary codes come from
+                # UNRELATED dictionaries (and even one dictionary is in
+                # order of appearance, not sorted) — remap both sides to
+                # ranks in a shared sorted value domain so =/!= and
+                # lexicographic ordering are exact
+                lut_l, lut_r = _shared_rank_luts(
+                    ds, lhs.codes_of, rhs.codes_of
+                )
+                lv = _gather_ranks(lut_l, lv)
+                rv = _gather_ranks(lut_r, rv)
+            elif (lhs.codes_of is None) != (rhs.codes_of is None):
+                raise PredicateParseError(
+                    "cannot compare a string column with a non-string "
+                    "operand (dictionary codes are not values)"
+                )
+            return _Val(_CMP_FNS[node.op](lv, rv), valid, is_bool=True)
+        if lhs.codes_of is not None or rhs.codes_of is not None:
+            raise PredicateParseError(
+                f"arithmetic {node.op!r} is undefined for string columns"
+            )
         if node.op == "+":
             return _Val(lv + rv, valid)
         if node.op == "-":

@@ -73,6 +73,33 @@ class TestPredicates:
         with pytest.raises(PredicateParseError):
             parse_predicate("AND x")
 
+    def test_string_column_to_column_comparison(self):
+        """Two string columns compare by VALUE, not by dictionary code
+        (codes come from unrelated dictionaries in order of appearance)."""
+        ds = Dataset.from_pydict(
+            {"a": ["x", "y", "z", "w"], "b": ["x", "q", "z", "x"]}
+        )
+        assert compliance(ds, "a = b") == 0.5
+        assert compliance(ds, "a != b") == 0.5
+        # lexicographic: x<x F, y<q F, z<z F, w<x T
+        assert compliance(ds, "a < b") == 0.25
+        assert compliance(ds, "a >= b") == 0.75
+
+    def test_string_column_literal_ordering(self):
+        ds = Dataset.from_pydict({"s": ["apple", "pear", "zebra", None]})
+        assert compliance(ds, "s >= 'pear'") == 0.5
+        assert compliance(ds, "'pear' <= s") == 0.5
+        assert compliance(ds, "s < 'b'") == 0.25
+
+    def test_string_numeric_mix_rejected(self):
+        """Comparing a string column to a numeric operand (or doing
+        arithmetic on codes) degrades to a failure METRIC — never a
+        silent wrong answer, never a raised exception."""
+        ds = Dataset.from_pydict({"s": ["a", "b"], "x": [1.0, 2.0]})
+        for pred in ("s = 1", "s < x", "s + 1 > 0"):
+            metric = Compliance("t", pred).calculate(ds)
+            assert metric.value.is_failure, pred
+
 
 class TestNullableBoolean:
     def test_numeric_analyzers_on_nullable_bool(self):
