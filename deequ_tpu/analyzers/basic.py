@@ -576,18 +576,19 @@ class StandardDeviation(_NumericColumnAnalyzer):
         ) -> S.StandardDeviationState:
             mask = _col_mask(batch, col, where_fn)
             x = batch[f"{col}::values"]
-            acc = _acc_float()
             if not jnp.issubdtype(x.dtype, jnp.floating):
                 # integral columns widen to f64 regardless of the knob
                 # (f32 would corrupt large ints, e.g. int64 timestamps)
                 x = x.astype(_F64)
-            nb = _mcount(mask).astype(acc)
+            # Welford state stays f64: n is an exact count and the
+            # moments are per-batch scalars (see states.py identity)
+            nb = _mcount(mask).astype(_F64)
             safe_nb = jnp.maximum(nb, 1.0)
-            mean_b = _msum(x, mask) / safe_nb
+            mean_b = _msum(x, mask).astype(_F64) / safe_nb
             # second moment: elementwise in the column dtype around the
-            # batch mean; only the scalar widens to the accumulation dtype
+            # batch mean; only the scalar widens to f64
             dx = jnp.where(mask, x - mean_b.astype(x.dtype), 0)
-            m2_b = jnp.sum(dx * dx).astype(acc)
+            m2_b = jnp.sum(dx * dx).astype(_F64)
             batch_state = S.StandardDeviationState(
                 nb, jnp.where(nb > 0, mean_b, 0.0), jnp.where(nb > 0, m2_b, 0.0)
             )
@@ -658,24 +659,24 @@ class Correlation(ScanShareableAnalyzer):
             mask = mask & _row_mask(batch, where_fn)
             x = batch[f"{ca}::values"]
             y = batch[f"{cb}::values"]
-            acc = _acc_float()
             if not jnp.issubdtype(x.dtype, jnp.floating):
                 x = x.astype(_F64)
             if not jnp.issubdtype(y.dtype, jnp.floating):
                 y = y.astype(_F64)
-            nb = _mcount(mask).astype(acc)
+            # co-moment state stays f64 like the Welford state
+            nb = _mcount(mask).astype(_F64)
             safe_nb = jnp.maximum(nb, 1.0)
-            x_avg = _msum(x, mask) / safe_nb
-            y_avg = _msum(y, mask) / safe_nb
+            x_avg = _msum(x, mask).astype(_F64) / safe_nb
+            y_avg = _msum(y, mask).astype(_F64) / safe_nb
             dx = jnp.where(mask, x - x_avg.astype(x.dtype), 0)
             dy = jnp.where(mask, y - y_avg.astype(y.dtype), 0)
             batch_state = S.CorrelationState(
                 nb,
                 jnp.where(nb > 0, x_avg, 0.0),
                 jnp.where(nb > 0, y_avg, 0.0),
-                jnp.sum(dx * dy).astype(acc),
-                jnp.sum(dx * dx).astype(acc),
-                jnp.sum(dy * dy).astype(acc),
+                jnp.sum(dx * dy).astype(_F64),
+                jnp.sum(dx * dx).astype(_F64),
+                jnp.sum(dy * dy).astype(_F64),
             )
             return S.CorrelationState.merge(state, batch_state)
 

@@ -133,7 +133,12 @@ class StandardDeviationState(NamedTuple):
 
     @staticmethod
     def identity() -> "StandardDeviationState":
-        return StandardDeviationState(_facc(0.0), _facc(0.0), _facc(0.0))
+        # always f64: n carries an exact count (config.py promises the
+        # accumulation knob never rounds counts) and the moments are
+        # per-batch scalars — f64 here costs a few emulated ops per
+        # batch, never per element
+        z = np.float64(0.0)
+        return StandardDeviationState(z, z, z)
 
     @staticmethod
     def merge(
@@ -159,7 +164,7 @@ class CorrelationState(NamedTuple):
 
     @staticmethod
     def identity() -> "CorrelationState":
-        z = _facc(0.0)
+        z = np.float64(0.0)  # see StandardDeviationState.identity
         return CorrelationState(z, z, z, z, z, z)
 
     @staticmethod

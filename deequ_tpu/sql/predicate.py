@@ -454,9 +454,10 @@ def _check_types(node: Node, schema) -> str:
             return "value"
         if isinstance(n, UnaryOp):
             k = kind_of(n.operand)
-            if n.op == "NEG" and k in ("string", "stringlit"):
+            if k in ("string", "stringlit"):
                 raise PredicateParseError(
-                    "negation is undefined for string operands"
+                    f"{'negation' if n.op == 'NEG' else 'NOT'} is "
+                    "undefined for string operands"
                 )
             return "value"
         if isinstance(n, IsNull):
@@ -491,8 +492,12 @@ def _check_types(node: Node, schema) -> str:
             return "value"
         if isinstance(n, BinOp):
             if n.op in ("AND", "OR"):
-                kind_of(n.left)
-                kind_of(n.right)
+                for side in (n.left, n.right):
+                    if kind_of(side) in ("string", "stringlit"):
+                        raise PredicateParseError(
+                            "a bare string operand is not a boolean "
+                            f"(in {n.op})"
+                        )
                 return "value"
             lk, rk = kind_of(n.left), kind_of(n.right)
             if n.op in _CMP:

@@ -1,0 +1,178 @@
+"""Anomaly-detection strategy tests: every strategy on synthetic series
+with hand-computed expected anomalies (reference test model: one test
+per strategy under anomalydetection/, incl. HoltWintersTest —
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.anomalydetection.base import (
+    AnomalyDetector,
+    DataPoint,
+)
+from deequ_tpu.anomalydetection.seasonal import (
+    HoltWinters,
+    MetricInterval,
+    SeriesSeasonality,
+)
+from deequ_tpu.anomalydetection.strategies import (
+    AbsoluteChangeStrategy,
+    BatchNormalStrategy,
+    OnlineNormalStrategy,
+    RelativeRateOfChangeStrategy,
+    SimpleThresholdStrategy,
+)
+
+
+def indices(found):
+    return [i for i, _ in found]
+
+
+class TestSimpleThreshold:
+    def test_bounds(self):
+        s = SimpleThresholdStrategy(lower_bound=-1.0, upper_bound=1.0)
+        found = s.detect([-2.0, -1.0, 0.0, 1.0, 2.0])
+        assert indices(found) == [0, 4]
+        assert found[0][1].value == -2.0
+
+    def test_search_interval(self):
+        s = SimpleThresholdStrategy(upper_bound=1.0)
+        found = s.detect([5.0, 5.0, 0.0, 5.0], search_interval=(2, 4))
+        assert indices(found) == [3]
+
+    def test_invalid_bounds(self):
+        with pytest.raises(ValueError):
+            SimpleThresholdStrategy(lower_bound=2.0, upper_bound=1.0)
+
+
+class TestAbsoluteChange:
+    def test_first_order(self):
+        s = AbsoluteChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0)
+        # diffs: 1, 1, 5, 1 -> index 3 jumps by 5
+        found = s.detect([1.0, 2.0, 3.0, 8.0, 9.0])
+        assert indices(found) == [3]
+
+    def test_second_order(self):
+        s = AbsoluteChangeStrategy(
+            max_rate_decrease=-1.0, max_rate_increase=1.0, order=2
+        )
+        # second differences of [1,2,3,10,4]: [0, 6, -13]
+        found = s.detect([1.0, 2.0, 3.0, 10.0, 4.0])
+        assert indices(found) == [3, 4]
+
+    def test_short_series(self):
+        s = AbsoluteChangeStrategy(order=3)
+        assert s.detect([1.0, 2.0]) == []
+
+    def test_invalid_params(self):
+        with pytest.raises(ValueError):
+            AbsoluteChangeStrategy(max_rate_decrease=1.0, max_rate_increase=0.0)
+        with pytest.raises(ValueError):
+            AbsoluteChangeStrategy(order=0)
+
+
+class TestRelativeRateOfChange:
+    def test_ratio_band(self):
+        s = RelativeRateOfChangeStrategy(
+            max_rate_decrease=0.5, max_rate_increase=2.0
+        )
+        # ratios: 2.0 (ok), 3.0 (high), 1/6 (low)
+        found = s.detect([1.0, 2.0, 6.0, 1.0])
+        assert indices(found) == [2, 3]
+
+
+class TestOnlineNormal:
+    def test_spike_detected(self):
+        rng = np.random.default_rng(0)
+        values = list(rng.normal(10.0, 1.0, 50))
+        values[40] = 100.0
+        s = OnlineNormalStrategy()
+        found = s.detect(values)
+        assert 40 in indices(found)
+
+    def test_ignore_anomalies_keeps_estimate_clean(self):
+        """With ignore_anomalies, a detected spike does not inflate the
+        running stddev, so a later smaller spike is still caught."""
+        rng = np.random.default_rng(1)
+        values = list(rng.normal(0.0, 1.0, 60))
+        values[30] = 50.0
+        values[45] = 10.0  # ~10 sigma, caught only if 50.0 was excluded
+        caught = indices(OnlineNormalStrategy(ignore_anomalies=True).detect(values))
+        assert 30 in caught and 45 in caught
+
+
+class TestBatchNormal:
+    def test_trains_outside_interval(self):
+        rng = np.random.default_rng(2)
+        values = list(rng.normal(5.0, 0.5, 30)) + [5.1, 20.0, 4.9]
+        s = BatchNormalStrategy()
+        found = s.detect(values, search_interval=(30, 33))
+        assert indices(found) == [31]
+
+    def test_needs_training_points(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy().detect([1.0, 2.0], search_interval=(0, 2))
+
+
+class TestHoltWinters:
+    @staticmethod
+    def weekly_series(weeks, spike_at=None):
+        """Additive weekly pattern + mild trend."""
+        pattern = np.array([10.0, 12.0, 14.0, 13.0, 11.0, 5.0, 4.0])
+        series = np.concatenate([pattern] * weeks)
+        series = series + 0.05 * np.arange(len(series))
+        if spike_at is not None:
+            series[spike_at] += 15.0
+        return list(series)
+
+    def test_forecast_accurate_on_clean_series(self):
+        values = self.weekly_series(5)
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        found = s.detect(values, search_interval=(28, 35))
+        assert found == []
+
+    def test_spike_in_forecast_window(self):
+        values = self.weekly_series(5, spike_at=30)
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        found = s.detect(values, search_interval=(28, 35))
+        assert indices(found) == [30]
+
+    def test_requires_two_periods_of_history(self):
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        with pytest.raises(ValueError):
+            s.detect(self.weekly_series(2), search_interval=(10, 14))
+
+    def test_monthly_yearly_period(self):
+        pattern = np.arange(12, dtype=float) * 2.0 + 3.0
+        values = list(np.concatenate([pattern] * 3))
+        values[30] += 40.0
+        s = HoltWinters(MetricInterval.MONTHLY, SeriesSeasonality.YEARLY)
+        found = s.detect(values, search_interval=(24, 36))
+        assert indices(found) == [30]
+
+
+class TestAnomalyDetector:
+    def test_new_point_anomalous(self):
+        history = [DataPoint(t, 1.0) for t in range(10)]
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=2.0))
+        assert detector.is_new_point_anomalous(
+            history, DataPoint(10, 5.0)
+        ).is_anomalous
+        assert not detector.is_new_point_anomalous(
+            history, DataPoint(10, 1.5)
+        ).is_anomalous
+
+    def test_history_sorted_and_nulls_dropped(self):
+        history = [
+            DataPoint(3, 3.0),
+            DataPoint(1, 1.0),
+            DataPoint(2, None),
+            DataPoint(0, 0.0),
+        ]
+        detector = AnomalyDetector(
+            AbsoluteChangeStrategy(max_rate_decrease=-1.5, max_rate_increase=1.5)
+        )
+        result = detector.is_new_point_anomalous(history, DataPoint(4, 13.0))
+        assert result.is_anomalous
+        # anomaly reported against the new point's timestamp
+        assert result.anomalies[0][0] == 4

@@ -1,0 +1,131 @@
+"""Column profiler tests: exact profile values on fixtures, string-type
+promotion, histograms, KLL percentiles (reference test model:
+ColumnProfilerRunnerTest — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Dataset
+from deequ_tpu.data.table import Kind
+from deequ_tpu.profiles.profiler import (
+    ColumnProfiler,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.profiles.runner import ColumnProfilerRunner
+
+
+@pytest.fixture(scope="module")
+def mixed_ds():
+    return Dataset.from_pydict(
+        {
+            "ints": [1, 2, 3, 4, 5, 6],
+            "floats": [1.0, 2.0, 3.0, 4.0, 5.0, None],
+            "cat": ["a", "b", "a", "a", "b", "a"],
+            "numeric_strings": ["1", "2", "3", "4", "5", "6"],
+            "mixed_strings": ["x", "2", "y", "z", "w", "v"],
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def profiles(mixed_ds):
+    return ColumnProfiler.profile(mixed_ds)
+
+
+class TestProfiles:
+    def test_num_records(self, profiles):
+        assert profiles.num_records == 6
+
+    def test_numeric_profile_exact_values(self, profiles):
+        p = profiles["ints"]
+        assert isinstance(p, NumericColumnProfile)
+        assert p.completeness == 1.0
+        assert p.mean == pytest.approx(3.5)
+        assert p.minimum == 1.0
+        assert p.maximum == 6.0
+        assert p.sum == 21.0
+        assert p.std_dev == pytest.approx(np.std([1, 2, 3, 4, 5, 6]))
+        assert p.data_type == Kind.INTEGRAL
+        assert not p.is_data_type_inferred
+
+    def test_nulls_in_completeness(self, profiles):
+        p = profiles["floats"]
+        assert p.completeness == pytest.approx(5 / 6)
+        assert p.mean == pytest.approx(3.0)  # nulls excluded
+
+    def test_string_histogram(self, profiles):
+        p = profiles["cat"]
+        assert isinstance(p, StandardColumnProfile)
+        assert p.data_type == Kind.STRING
+        assert p.histogram is not None
+        assert p.histogram.values["a"].absolute == 4
+        assert p.histogram.values["b"].absolute == 2
+        assert p.histogram.values["a"].ratio == pytest.approx(4 / 6)
+
+    def test_numeric_string_promotion(self, profiles):
+        """All-numeric string column is profiled as numeric (reference:
+        pass-2 casts a projected copy — SURVEY.md §3.3)."""
+        p = profiles["numeric_strings"]
+        assert isinstance(p, NumericColumnProfile)
+        assert p.is_data_type_inferred
+        assert p.data_type == Kind.INTEGRAL
+        assert p.mean == pytest.approx(3.5)
+        assert p.type_counts.get("Integral") == 6
+
+    def test_mixed_string_not_promoted(self, profiles):
+        p = profiles["mixed_strings"]
+        assert not isinstance(p, NumericColumnProfile)
+        assert p.data_type == Kind.STRING
+
+    def test_approx_distinct(self, profiles):
+        assert profiles["cat"].approximate_num_distinct_values == pytest.approx(
+            2, abs=0.5
+        )
+        assert profiles["ints"].approximate_num_distinct_values == pytest.approx(
+            6, abs=1.0
+        )
+
+
+class TestProfilerOptions:
+    def test_restrict_to_columns(self, mixed_ds):
+        result = ColumnProfiler.profile(
+            mixed_ds, restrict_to_columns=["ints"]
+        )
+        assert set(result.profiles.keys()) == {"ints"}
+        with pytest.raises(KeyError):
+            ColumnProfiler.profile(mixed_ds, restrict_to_columns=["nope"])
+
+    def test_low_cardinality_threshold_gates_histograms(self, mixed_ds):
+        result = ColumnProfiler.profile(
+            mixed_ds, low_cardinality_histogram_threshold=1
+        )
+        assert result["cat"].histogram is None
+
+    def test_kll_profiling(self):
+        ds = Dataset.from_pydict({"x": list(np.arange(1000.0))})
+        result = ColumnProfiler.profile(ds, kll_profiling=True)
+        p = result["x"]
+        assert p.kll is not None
+        assert p.approx_percentiles is not None
+        assert len(p.approx_percentiles) == 99
+        # median of 0..999 ~ 500
+        assert p.approx_percentiles[49] == pytest.approx(500, abs=15)
+
+    def test_empty_dataset(self):
+        ds = Dataset.from_pydict({"x": []})
+        result = ColumnProfiler.profile(ds)
+        assert result.num_records == 0
+        assert result["x"].completeness == 0.0
+
+
+class TestRunnerBuilder:
+    def test_runner_end_to_end(self, mixed_ds):
+        result = (
+            ColumnProfilerRunner()
+            .on_data(mixed_ds)
+            .restrict_to_columns(["ints", "cat"])
+            .run()
+        )
+        assert set(result.profiles.keys()) == {"ints", "cat"}
+        assert result["ints"].mean == pytest.approx(3.5)

@@ -1,0 +1,202 @@
+"""Metrics repository tests: serde goldens for every metric type,
+filesystem round-trips, and time-travel queries (reference test model:
+AnalysisResultSerdeTest + repository tests — SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Dataset
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxQuantiles,
+    Completeness,
+    DataType,
+    Histogram,
+    KLLSketch,
+    Mean,
+    Size,
+)
+from deequ_tpu.analyzers.runner import AnalyzerContext
+from deequ_tpu.repository import serde
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    InMemoryMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+
+@pytest.fixture(scope="module")
+def context():
+    """One context containing every metric shape: DoubleMetric,
+    KeyedDoubleMetric, HistogramMetric, KLLMetric, and a failure."""
+    ds = Dataset.from_pydict(
+        {
+            "x": [1.0, 2.0, 3.0, 4.0, None],
+            "cat": ["a", "b", "a", "a", "b"],
+            "s": ["1", "2", "3", "x", None],
+        }
+    )
+    analyzers = [
+        Size(),
+        Mean("x"),
+        Completeness("x"),
+        Histogram("cat"),
+        ApproxQuantiles("x", (0.25, 0.5, 0.75)),
+        KLLSketch("x"),
+        DataType("s"),
+        Mean("missing_column"),  # -> failure metric
+    ]
+    return AnalysisRunner.do_analysis_run(ds, analyzers)
+
+
+class TestSerde:
+    def test_round_trip_preserves_everything(self, context):
+        key = ResultKey.of(1700000000000, {"pipeline": "daily", "env": "test"})
+        text = serde.serialize([AnalysisResult(key, context)])
+        results = serde.deserialize(text)
+        assert len(results) == 1
+        restored = results[0]
+        assert restored.result_key == key
+        original = context.metric_map
+        loaded = restored.analyzer_context.metric_map
+        assert set(loaded.keys()) == set(original.keys())
+        for analyzer, metric in original.items():
+            got = loaded[analyzer]
+            assert type(got) is type(metric)
+            assert got.name == metric.name
+            assert got.instance == metric.instance
+            if metric.value.is_failure:
+                assert got.value.is_failure
+                continue
+            want, have = metric.value.get(), got.value.get()
+            if isinstance(want, dict):  # KeyedDoubleMetric
+                assert have == pytest.approx(want)
+            elif hasattr(want, "values"):  # Distribution
+                assert {
+                    k: (v.absolute, v.ratio) for k, v in have.values.items()
+                } == {
+                    k: (v.absolute, v.ratio) for k, v in want.values.items()
+                }
+            elif hasattr(want, "buckets"):  # BucketDistribution
+                assert [
+                    (b.low_value, b.high_value, b.count) for b in have.buckets
+                ] == [
+                    (b.low_value, b.high_value, b.count) for b in want.buckets
+                ]
+            else:
+                assert have == pytest.approx(want)
+
+    def test_serialized_form_is_json(self, context):
+        key = ResultKey.of(123, {})
+        parsed = json.loads(serde.serialize([AnalysisResult(key, context)]))
+        assert isinstance(parsed, list)
+
+    def test_failure_metric_round_trip(self, context):
+        bad = Mean("missing_column")
+        key = ResultKey.of(5, {})
+        restored = serde.deserialize(
+            serde.serialize([AnalysisResult(key, context)])
+        )[0]
+        metric = restored.analyzer_context.metric(bad)
+        assert metric is not None and metric.value.is_failure
+
+
+class TestInMemoryRepository:
+    def test_save_load_by_key(self, context):
+        repo = InMemoryMetricsRepository()
+        key = ResultKey.of(100, {"tag": "a"})
+        repo.save(AnalysisResult(key, context))
+        assert repo.load_by_key(key) is not None
+        assert repo.load_by_key(ResultKey.of(100, {"tag": "b"})) is None
+
+    def test_time_travel_and_tags(self, context):
+        repo = InMemoryMetricsRepository()
+        for t, env in [(100, "dev"), (200, "prod"), (300, "prod")]:
+            repo.save(AnalysisResult(ResultKey.of(t, {"env": env}), context))
+        assert len(repo.load().after(150).get()) == 2
+        assert len(repo.load().before(250).get()) == 2
+        assert len(repo.load().after(150).before(250).get()) == 1
+        assert len(repo.load().with_tag_values({"env": "prod"}).get()) == 2
+        records = (
+            repo.load()
+            .with_tag_values({"env": "prod"})
+            .for_analyzers([Size()])
+            .get_success_metrics_as_records()
+        )
+        assert all(r["name"] == "Size" for r in records)
+        assert {r["dataset_date"] for r in records} == {200, 300}
+        assert all(r["env"] == "prod" for r in records)
+
+
+class TestFileSystemRepository:
+    def test_round_trip(self, context, tmp_path):
+        path = os.path.join(tmp_path, "metrics.json")
+        repo = FileSystemMetricsRepository(path)
+        key = ResultKey.of(100, {"run": "r1"})
+        repo.save(AnalysisResult(key, context))
+        # a second process/repo instance sees the data
+        repo2 = FileSystemMetricsRepository(path)
+        loaded = repo2.load_by_key(key)
+        assert loaded is not None
+        assert loaded.analyzer_context.metric(Size()).value.get() == 5.0
+
+    def test_save_same_key_overwrites(self, context, tmp_path):
+        path = os.path.join(tmp_path, "metrics.json")
+        repo = FileSystemMetricsRepository(path)
+        key = ResultKey.of(100, {})
+        repo.save(AnalysisResult(key, context))
+        repo.save(AnalysisResult(key, context))
+        assert len(repo.load().get()) == 1
+
+    def test_query_across_saves(self, context, tmp_path):
+        path = os.path.join(tmp_path, "metrics.json")
+        repo = FileSystemMetricsRepository(path)
+        for t in (10, 20, 30):
+            repo.save(AnalysisResult(ResultKey.of(t, {}), context))
+        got = repo.load().after(15).get()
+        assert [r.result_key.dataset_date for r in got] == [20, 30]
+
+
+class TestRunnerRepositoryIntegration:
+    def test_reuse_existing_results(self, context):
+        """The runner reuses repository metrics instead of recomputing
+        (SURVEY.md §2.4 step 1)."""
+        repo = InMemoryMetricsRepository()
+        key = ResultKey.of(1, {})
+        ds = Dataset.from_pydict({"x": [1.0, 2.0, 3.0]})
+        ctx1 = (
+            AnalysisRunner.on_data(ds)
+            .add_analyzer(Mean("x"))
+            .use_repository(repo)
+            .save_or_append_result(key)
+            .run()
+        )
+        assert ctx1.metric(Mean("x")).value.get() == 2.0
+        # different data, same key: reused metric wins (no recompute)
+        ds2 = Dataset.from_pydict({"x": [100.0, 200.0]})
+        ctx2 = (
+            AnalysisRunner.on_data(ds2)
+            .add_analyzer(Mean("x"))
+            .use_repository(repo)
+            .reuse_existing_results_for_key(key)
+            .run()
+        )
+        assert ctx2.metric(Mean("x")).value.get() == 2.0
+
+    def test_fail_if_results_missing(self):
+        repo = InMemoryMetricsRepository()
+        ds = Dataset.from_pydict({"x": [1.0]})
+        with pytest.raises(RuntimeError):
+            (
+                AnalysisRunner.on_data(ds)
+                .add_analyzer(Mean("x"))
+                .use_repository(repo)
+                .reuse_existing_results_for_key(
+                    ResultKey.of(9, {}), fail_if_results_missing=True
+                )
+                .run()
+            )

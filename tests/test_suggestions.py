@@ -1,0 +1,221 @@
+"""Constraint-suggestion tests: each rule's fire/no-fire boundary plus
+the runner's train/test holdout evaluation (reference test model:
+ConstraintSuggestionRunnerTest + per-rule tests — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Dataset
+from deequ_tpu.checks.check import CheckStatus
+from deequ_tpu.data.table import Kind
+from deequ_tpu.metrics.distribution import Distribution, DistributionValue
+from deequ_tpu.profiles.profiler import (
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.suggestions.rules import (
+    DEFAULT_RULES,
+    CategoricalRangeRule,
+    CompleteIfCompleteRule,
+    FractionalCategoricalRangeRule,
+    NonNegativeNumbersRule,
+    RetainCompletenessRule,
+    RetainTypeRule,
+    UniqueIfApproximatelyUniqueRule,
+)
+from deequ_tpu.suggestions.runner import ConstraintSuggestionRunner
+
+
+def std_profile(**kwargs):
+    base = dict(
+        column="col",
+        completeness=1.0,
+        approximate_num_distinct_values=10.0,
+        data_type=Kind.STRING,
+        is_data_type_inferred=False,
+        type_counts={},
+        histogram=None,
+    )
+    base.update(kwargs)
+    return StandardColumnProfile(**base)
+
+
+def num_profile(**kwargs):
+    base = dict(
+        column="col",
+        completeness=1.0,
+        approximate_num_distinct_values=10.0,
+        data_type=Kind.FRACTIONAL,
+        is_data_type_inferred=False,
+        type_counts={},
+        histogram=None,
+        mean=1.0,
+        maximum=5.0,
+        minimum=0.0,
+        sum=10.0,
+        std_dev=1.0,
+    )
+    base.update(kwargs)
+    return NumericColumnProfile(**base)
+
+
+def histogram(counts):
+    total = sum(counts.values())
+    return Distribution(
+        {k: DistributionValue(v, v / total) for k, v in counts.items()},
+        len(counts),
+    )
+
+
+class TestRuleBoundaries:
+    def test_complete_if_complete(self):
+        rule = CompleteIfCompleteRule()
+        assert rule.should_be_applied(std_profile(completeness=1.0), 100)
+        assert not rule.should_be_applied(std_profile(completeness=0.99), 100)
+        s = rule.candidate(std_profile(), 100)
+        assert ".is_complete" in s.code_for_constraint
+
+    def test_retain_completeness_interval_math(self):
+        rule = RetainCompletenessRule()
+        assert rule.should_be_applied(std_profile(completeness=0.5), 100)
+        assert rule.should_be_applied(std_profile(completeness=0.2), 100)
+        assert not rule.should_be_applied(std_profile(completeness=0.19), 100)
+        assert not rule.should_be_applied(std_profile(completeness=1.0), 100)
+        # p=0.5, n=100: bound = 0.5 - 1.96*sqrt(0.25/100) = 0.402 -> 0.4
+        s = rule.candidate(std_profile(completeness=0.5), 100)
+        assert "0.4" in s.code_for_constraint
+
+    def test_retain_type(self):
+        rule = RetainTypeRule()
+        fires = std_profile(
+            is_data_type_inferred=True, data_type=Kind.INTEGRAL
+        )
+        assert rule.should_be_applied(fires, 10)
+        assert not rule.should_be_applied(
+            std_profile(is_data_type_inferred=True, data_type=Kind.STRING), 10
+        )
+        assert not rule.should_be_applied(
+            std_profile(is_data_type_inferred=False, data_type=Kind.INTEGRAL),
+            10,
+        )
+        assert "has_data_type" in rule.candidate(fires, 10).code_for_constraint
+
+    def test_categorical_range(self):
+        rule = CategoricalRangeRule()
+        fires = std_profile(
+            histogram=histogram({"a": 60, "b": 40}),
+            approximate_num_distinct_values=2.0,
+        )
+        assert rule.should_be_applied(fires, 1000)
+        # no histogram -> never
+        assert not rule.should_be_applied(std_profile(), 1000)
+        # high unique ratio -> no
+        assert not rule.should_be_applied(
+            std_profile(
+                histogram=histogram({"a": 1, "b": 1}),
+                approximate_num_distinct_values=500.0,
+            ),
+            1000,
+        )
+        s = rule.candidate(fires, 1000)
+        assert '.is_contained_in("col", ["a", "b"])' in s.code_for_constraint
+
+    def test_fractional_categorical_range(self):
+        rule = FractionalCategoricalRangeRule()
+        # two categories cover 98%, the tail is tiny -> fires
+        fires = std_profile(
+            histogram=histogram({"a": 600, "b": 380, "junk": 20})
+        )
+        assert rule.should_be_applied(fires, 1000)
+        # coverage target only reached by using ALL values -> no
+        assert not rule.should_be_applied(
+            std_profile(histogram=histogram({"a": 50, "b": 50})), 100
+        )
+        s = rule.candidate(fires, 1000)
+        assert "is_contained_in" in s.code_for_constraint
+        assert "junk" not in s.code_for_constraint
+
+    def test_non_negative_numbers(self):
+        rule = NonNegativeNumbersRule()
+        assert rule.should_be_applied(num_profile(minimum=0.0), 10)
+        assert not rule.should_be_applied(num_profile(minimum=-0.1), 10)
+        assert not rule.should_be_applied(std_profile(), 10)
+
+    def test_unique_if_approximately_unique(self):
+        rule = UniqueIfApproximatelyUniqueRule()
+        assert rule.should_be_applied(
+            std_profile(approximate_num_distinct_values=95.0), 100
+        )
+        assert not rule.should_be_applied(
+            std_profile(approximate_num_distinct_values=80.0), 100
+        )
+        # incomplete columns are never suggested unique
+        assert not rule.should_be_applied(
+            std_profile(
+                approximate_num_distinct_values=100.0, completeness=0.9
+            ),
+            100,
+        )
+
+
+class TestSuggestionRunner:
+    @pytest.fixture(scope="class")
+    def ds(self):
+        n = 400
+        rng = np.random.default_rng(7)
+        return Dataset.from_pydict(
+            {
+                "id": list(range(n)),
+                "cat": list(rng.choice(["x", "y", "z"], n)),
+                "maybe": [
+                    float(i) if i % 4 else None for i in range(n)
+                ],
+            }
+        )
+
+    def test_default_rules_produce_expected_suggestions(self, ds):
+        result = (
+            ConstraintSuggestionRunner()
+            .on_data(ds)
+            .add_constraint_rules(DEFAULT_RULES)
+            .run()
+        )
+        by_rule = {
+            s.suggesting_rule for s in result.all_suggestions()
+        }
+        assert "CompleteIfCompleteRule" in by_rule  # id, cat complete
+        assert "UniqueIfApproximatelyUniqueRule" in by_rule  # id unique
+        assert "CategoricalRangeRule" in by_rule  # cat low-card
+        assert "RetainCompletenessRule" in by_rule  # maybe ~75%
+        id_rules = {
+            s.suggesting_rule
+            for s in result.constraint_suggestions.get("id", [])
+        }
+        assert "NonNegativeNumbersRule" in id_rules
+
+    def test_train_test_split_evaluates_holdout(self, ds):
+        result = (
+            ConstraintSuggestionRunner()
+            .on_data(ds)
+            .add_constraint_rules(DEFAULT_RULES)
+            .use_train_test_split_with_testset_ratio(0.25)
+            .run()
+        )
+        vr = result.verification_result
+        assert vr is not None
+        # structure holds on the holdout: all suggested constraints pass
+        assert vr.status in (CheckStatus.SUCCESS, CheckStatus.WARNING)
+
+    def test_rule_exception_does_not_kill_run(self, ds):
+        class ExplodingRule(CompleteIfCompleteRule):
+            def should_be_applied(self, profile, num_records):
+                raise RuntimeError("boom")
+
+        result = (
+            ConstraintSuggestionRunner()
+            .on_data(ds)
+            .add_constraint_rule(ExplodingRule())
+            .add_constraint_rule(CompleteIfCompleteRule())
+            .run()
+        )
+        assert result.all_suggestions()  # the healthy rule still ran
