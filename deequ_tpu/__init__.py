@@ -30,6 +30,7 @@ if os.environ.get("DEEQU_TPU_NO_X64", "0") != "1":
 
     jax.config.update("jax_enable_x64", True)
 
+from deequ_tpu import config  # noqa: E402
 from deequ_tpu.metrics import (  # noqa: E402
     DoubleMetric,
     Entity,
@@ -43,25 +44,152 @@ from deequ_tpu.verification import (  # noqa: E402
     VerificationResult,
     VerificationSuite,
 )
-from deequ_tpu.analyzers.runner import (  # noqa: E402
+from deequ_tpu.analyzers import (  # noqa: E402
     AnalysisRunner,
     AnalyzerContext,
+    Applicability,
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    ColumnCount,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    CustomSql,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    KLLSketch,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    MutualInformation,
+    PatternMatch,
+    RatioOfSums,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
 )
+from deequ_tpu.engine import AnalysisEngine  # noqa: E402
+from deequ_tpu.io.state_provider import (  # noqa: E402
+    FileSystemStateProvider,
+    InMemoryStateProvider,
+)
+from deequ_tpu.profiles.profiler import (  # noqa: E402
+    ColumnProfiler,
+    ColumnProfiles,
+)
+from deequ_tpu.profiles.runner import ColumnProfilerRunner  # noqa: E402
+from deequ_tpu.repository.base import (  # noqa: E402
+    AnalysisResult,
+    InMemoryMetricsRepository,
+    MetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository.fs import FileSystemMetricsRepository  # noqa: E402
+from deequ_tpu.suggestions.rules import DEFAULT_RULES  # noqa: E402
+from deequ_tpu.suggestions.runner import (  # noqa: E402
+    ConstraintSuggestionResult,
+    ConstraintSuggestionRunner,
+)
+from deequ_tpu.anomalydetection.base import (  # noqa: E402
+    AnomalyDetector,
+    DataPoint,
+)
+from deequ_tpu.anomalydetection.strategies import (  # noqa: E402
+    AbsoluteChangeStrategy,
+    BatchNormalStrategy,
+    OnlineNormalStrategy,
+    RelativeRateOfChangeStrategy,
+    SimpleThresholdStrategy,
+)
+from deequ_tpu.anomalydetection.seasonal import (  # noqa: E402
+    HoltWinters,
+    MetricInterval,
+    SeriesSeasonality,
+)
+from deequ_tpu.schema import (  # noqa: E402
+    RowLevelSchema,
+    RowLevelSchemaValidator,
+)
+from deequ_tpu.sketches.kll import KLLParameters  # noqa: E402
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
+    "AbsoluteChangeStrategy",
+    "AnalysisEngine",
+    "AnalysisResult",
     "AnalysisRunner",
     "AnalyzerContext",
+    "AnomalyDetector",
+    "Applicability",
+    "ApproxCountDistinct",
+    "ApproxQuantile",
+    "ApproxQuantiles",
+    "BatchNormalStrategy",
     "Check",
     "CheckLevel",
     "CheckStatus",
+    "ColumnCount",
+    "ColumnProfiler",
+    "ColumnProfilerRunner",
+    "ColumnProfiles",
+    "Completeness",
+    "Compliance",
+    "ConstraintSuggestionResult",
+    "ConstraintSuggestionRunner",
+    "Correlation",
+    "CountDistinct",
+    "CustomSql",
+    "DEFAULT_RULES",
+    "DataPoint",
+    "DataType",
     "Dataset",
+    "Distinctness",
     "DoubleMetric",
     "Entity",
+    "Entropy",
+    "FileSystemMetricsRepository",
+    "FileSystemStateProvider",
+    "Histogram",
     "HistogramMetric",
+    "HoltWinters",
+    "InMemoryMetricsRepository",
+    "InMemoryStateProvider",
     "KLLMetric",
+    "KLLParameters",
+    "KLLSketch",
+    "Maximum",
+    "MaxLength",
+    "Mean",
     "Metric",
+    "MetricInterval",
+    "MetricsRepository",
+    "Minimum",
+    "MinLength",
+    "MutualInformation",
+    "OnlineNormalStrategy",
+    "PatternMatch",
+    "RatioOfSums",
+    "RelativeRateOfChangeStrategy",
+    "ResultKey",
+    "RowLevelSchema",
+    "RowLevelSchemaValidator",
+    "SeriesSeasonality",
+    "SimpleThresholdStrategy",
+    "Size",
+    "StandardDeviation",
+    "Sum",
+    "Uniqueness",
+    "UniqueValueRatio",
     "VerificationResult",
     "VerificationSuite",
+    "config",
 ]

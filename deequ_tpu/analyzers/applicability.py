@@ -1,0 +1,107 @@
+"""Applicability: can this check / these analyzers run on this schema?
+
+Reference: ``analyzers/applicability/Applicability.scala`` (SURVEY.md
+§1 L12): instantiate the check or analyzers against a ``StructType``,
+synthesize a row of matching types, and report per-constraint/
+per-analyzer applicability. Here the synthesized data is a two-row
+typed Arrow table generated from the Schema's kinds; each analyzer runs
+through the ordinary runner, so precondition failures AND runtime
+planning failures (bad predicate, wrong types) surface exactly as they
+would in production — as failure metrics, mapped to per-item report
+entries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from typing import TYPE_CHECKING
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.data.table import Dataset, Kind, Schema
+
+if TYPE_CHECKING:  # avoid the analyzers <-> checks import cycle
+    from deequ_tpu.checks.check import Check
+
+
+def _synthesize_dataset(schema: Schema, num_rows: int = 2) -> Dataset:
+    """A tiny typed table matching the schema's kinds (reference:
+    Applicability synthesizes a row of matching types)."""
+    arrays = {}
+    for f in schema.fields:
+        if f.kind == Kind.INTEGRAL:
+            arrays[f.name] = pa.array(
+                np.arange(1, num_rows + 1, dtype=np.int64)
+            )
+        elif f.kind == Kind.FRACTIONAL:
+            arrays[f.name] = pa.array(
+                np.linspace(1.0, 2.0, num_rows).astype(np.float64)
+            )
+        elif f.kind == Kind.BOOLEAN:
+            arrays[f.name] = pa.array(
+                [(i % 2 == 0) for i in range(num_rows)]
+            )
+        elif f.kind == Kind.TIMESTAMP:
+            arrays[f.name] = pa.array(
+                np.arange(num_rows, dtype=np.int64),
+                pa.timestamp("ms"),
+            )
+        else:  # STRING / UNKNOWN
+            arrays[f.name] = pa.array([f"v{i}" for i in range(num_rows)])
+    return Dataset(pa.table(arrays))
+
+
+@dataclass
+class ApplicabilityResult:
+    is_applicable: bool
+    # item (constraint repr or analyzer repr) -> None if ok, else reason
+    failures: Dict[str, Optional[str]] = field(default_factory=dict)
+
+
+class Applicability:
+    """Evaluates checks/analyzers against a Schema without real data."""
+
+    def is_applicable(
+        self, check: "Check", schema: Schema
+    ) -> ApplicabilityResult:
+        """Per-constraint applicability of a whole check."""
+        data = _synthesize_dataset(schema)
+        analyzers = check.required_analyzers()
+        context = AnalysisRunner.do_analysis_run(data, analyzers)
+        failures: Dict[str, Optional[str]] = {}
+        ok = True
+        for constraint_result in check.evaluate(context).constraint_results:
+            name = repr(constraint_result.constraint)
+            metric = constraint_result.metric
+            if metric is not None and metric.value.is_failure:
+                failures[name] = str(metric.value.exception)
+                ok = False
+            else:
+                failures[name] = None
+        return ApplicabilityResult(ok, failures)
+
+    def are_applicable(
+        self, analyzers: Sequence[Analyzer], schema: Schema
+    ) -> ApplicabilityResult:
+        """Per-analyzer applicability."""
+        data = _synthesize_dataset(schema)
+        context = AnalysisRunner.do_analysis_run(data, list(analyzers))
+        failures: Dict[str, Optional[str]] = {}
+        ok = True
+        for analyzer in analyzers:
+            metric = context.metric(analyzer)
+            if metric is None or metric.value.is_failure:
+                failures[repr(analyzer)] = (
+                    str(metric.value.exception)
+                    if metric is not None
+                    else "no metric computed"
+                )
+                ok = False
+            else:
+                failures[repr(analyzer)] = None
+        return ApplicabilityResult(ok, failures)

@@ -1,0 +1,323 @@
+"""CustomSql: a metric from an arbitrary scalar aggregate expression.
+
+Reference: ``analyzers/CustomSql.scala`` (SURVEY.md §2.2, newer
+upstream): run arbitrary SQL returning one double. The reference hands
+the statement to Spark SQL; here the expression compiles onto the fused
+scan: every aggregate call (SUM/COUNT/AVG/MIN/MAX, COUNT(*)) becomes a
+slot in a mergeable state updated in the shared pass, and the
+surrounding arithmetic evaluates host-side over the final scalars. So
+``CustomSql("SUM(a) / SUM(b) + 1")`` costs the same single data pass as
+every other scan-shareable analyzer, and its state merges across
+batches/mesh/persisted increments like any other monoid.
+
+State layout: one universal aggregate cell per slot, stored as four
+parallel vectors (sums f64[k], counts i64[k], mins f64[k], maxs f64[k])
+— a fixed-shape pytree with a slot-count-independent elementwise merge,
+so the incremental path can merge persisted states without knowing the
+expression.
+
+Supported grammar: the predicate expression language (deequ_tpu.sql)
+with aggregate calls over a single column (or ``*`` for COUNT) combined
+with +, -, *, /, %, and numeric literals. WHERE-style filtering uses the
+analyzer's ``where`` parameter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    EmptyStateException,
+    IllegalAnalyzerParameterException,
+    Precondition,
+    ScanOps,
+    ScanShareableAnalyzer,
+    has_column,
+    is_numeric,
+)
+from deequ_tpu.analyzers.basic import (
+    _col_mask,
+    _compile_where,
+    _mcount,
+    _mmax,
+    _mmin,
+    _msum,
+    _row_mask,
+)
+from deequ_tpu.data.table import ColumnRequest, Dataset
+from deequ_tpu.metrics.metric import DoubleMetric, Entity
+from deequ_tpu.sql.predicate import (
+    BinOp,
+    ColumnRef,
+    FuncCall,
+    Node,
+    NumberLit,
+    PredicateParseError,
+    StarLit,
+    UnaryOp,
+    parse_predicate,
+)
+
+_AGGREGATES = ("SUM", "COUNT", "AVG", "MIN", "MAX")
+
+# aggregate slot: (function name, column name or "*")
+_Slot = Tuple[str, str]
+
+
+class CustomSqlState(NamedTuple):
+    """k universal aggregate cells as parallel vectors; merge is
+    elementwise and expression-independent."""
+
+    sums: jnp.ndarray  # f64[k]
+    counts: jnp.ndarray  # i64[k]
+    mins: jnp.ndarray  # f64[k]
+    maxs: jnp.ndarray  # f64[k]
+
+    @staticmethod
+    def identity(k: int) -> "CustomSqlState":
+        return CustomSqlState(
+            np.zeros(k, dtype=np.float64),
+            np.zeros(k, dtype=np.int64),
+            np.full(k, np.inf, dtype=np.float64),
+            np.full(k, -np.inf, dtype=np.float64),
+        )
+
+    @staticmethod
+    def merge(a: "CustomSqlState", b: "CustomSqlState") -> "CustomSqlState":
+        return CustomSqlState(
+            a.sums + b.sums,
+            a.counts + b.counts,
+            jnp.minimum(a.mins, b.mins),
+            jnp.maximum(a.maxs, b.maxs),
+        )
+
+
+def _collect_aggregates(node: Node, out: List[_Slot]) -> None:
+    """Walk the AST collecting aggregate calls; validate that column
+    references appear ONLY inside aggregates (a bare column has no
+    scalar meaning in an aggregate expression)."""
+    if isinstance(node, FuncCall) and node.name in _AGGREGATES:
+        if len(node.args) != 1:
+            raise PredicateParseError(
+                f"{node.name} takes exactly one argument"
+            )
+        arg = node.args[0]
+        if isinstance(arg, StarLit):
+            if node.name != "COUNT":
+                raise PredicateParseError(
+                    f"* is only valid in COUNT(*), not {node.name}"
+                )
+            slot = (node.name, "*")
+        elif isinstance(arg, ColumnRef):
+            slot = (node.name, arg.name)
+        else:
+            raise PredicateParseError(
+                f"{node.name} expects a column (or * for COUNT)"
+            )
+        if slot not in out:
+            out.append(slot)
+        return
+    if isinstance(node, ColumnRef):
+        raise PredicateParseError(
+            f"bare column {node.name!r} outside an aggregate — aggregate "
+            "expressions reduce to one scalar"
+        )
+    if isinstance(node, NumberLit):
+        return
+    if isinstance(node, UnaryOp) and node.op == "NEG":
+        _collect_aggregates(node.operand, out)
+        return
+    if isinstance(node, BinOp) and node.op in ("+", "-", "*", "/", "%"):
+        _collect_aggregates(node.left, out)
+        _collect_aggregates(node.right, out)
+        return
+    raise PredicateParseError(
+        f"unsupported node in aggregate expression: {node!r}"
+    )
+
+
+def _finalize(node: Node, values: Dict[_Slot, float]) -> float:
+    """Host-side arithmetic over the final aggregate scalars."""
+    if isinstance(node, FuncCall) and node.name in _AGGREGATES:
+        arg = node.args[0]
+        col = "*" if isinstance(arg, StarLit) else arg.name  # type: ignore[union-attr]
+        return values[(node.name, col)]
+    if isinstance(node, NumberLit):
+        return node.value
+    if isinstance(node, UnaryOp):
+        return -_finalize(node.operand, values)
+    if isinstance(node, BinOp):
+        left = _finalize(node.left, values)
+        right = _finalize(node.right, values)
+        if node.op == "+":
+            return left + right
+        if node.op == "-":
+            return left - right
+        if node.op == "*":
+            return left * right
+        if node.op == "/":
+            if right == 0:
+                raise IllegalAnalyzerParameterException(
+                    "division by zero in CustomSql expression"
+                )
+            return left / right
+        if node.op == "%":
+            if right == 0:
+                raise IllegalAnalyzerParameterException(
+                    "modulo by zero in CustomSql expression"
+                )
+            return left % right
+    raise PredicateParseError(f"cannot finalize node {node!r}")
+
+
+# persisted-state serde registration (state_provider resolves by name)
+from deequ_tpu.analyzers.states import STATE_TYPES  # noqa: E402
+
+STATE_TYPES.setdefault("CustomSqlState", CustomSqlState)
+
+
+@dataclass(frozen=True)
+class CustomSql(ScanShareableAnalyzer):
+    expression: str
+    where: Optional[str] = None
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.DATASET
+
+    @property
+    def instance(self) -> str:
+        return self.expression
+
+    def _plan(self) -> Tuple[Node, List[_Slot]]:
+        node = parse_predicate(self.expression)
+        slots: List[_Slot] = []
+        _collect_aggregates(node, slots)
+        if not slots:
+            raise PredicateParseError(
+                "aggregate expression contains no aggregate call"
+            )
+        return node, slots
+
+    def preconditions(self) -> List[Precondition]:
+        try:
+            _, slots = self._plan()
+        except PredicateParseError:
+            # surface the parse error as a failure metric at run time
+            def bad(schema):
+                self._plan()
+
+            return [bad]
+        checks: List[Precondition] = []
+        for func, col in slots:
+            if col == "*":
+                continue
+            checks.append(has_column(col))
+            if func in ("SUM", "AVG", "MIN", "MAX"):
+                checks.append(is_numeric(col))
+        return checks
+
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        _, slots = self._plan()
+        _, where_reqs = _compile_where(self.where, dataset)
+        requests: List[ColumnRequest] = list(where_reqs)
+        for _, col in slots:
+            if col == "*":
+                continue
+            requests.append(ColumnRequest(col, "values"))
+            requests.append(ColumnRequest(col, "mask"))
+        return requests
+
+    def make_ops(self, dataset: Dataset) -> ScanOps:
+        _, slots = self._plan()
+        where_fn, _ = _compile_where(self.where, dataset)
+        k = len(slots)
+
+        def update(state: CustomSqlState, batch) -> CustomSqlState:
+            sums, counts, mins, maxs = [], [], [], []
+            for func, col in slots:
+                if col == "*":
+                    mask = _row_mask(batch, where_fn)
+                    sums.append(jnp.float64(0.0))
+                    counts.append(_mcount(mask))
+                    mins.append(jnp.float64(jnp.inf))
+                    maxs.append(jnp.float64(-jnp.inf))
+                    continue
+                mask = _col_mask(batch, col, where_fn)
+                values = batch[f"{col}::values"]
+                need_sum = func in ("SUM", "AVG")
+                need_ends = func in ("MIN", "MAX")
+                sums.append(
+                    _msum(values, mask).astype(jnp.float64)
+                    if need_sum
+                    else jnp.float64(0.0)
+                )
+                counts.append(_mcount(mask))
+                mins.append(
+                    _mmin(values, mask) if need_ends else jnp.float64(jnp.inf)
+                )
+                maxs.append(
+                    _mmax(values, mask)
+                    if need_ends
+                    else jnp.float64(-jnp.inf)
+                )
+            batch_state = CustomSqlState(
+                jnp.stack(sums), jnp.stack(counts),
+                jnp.stack(mins), jnp.stack(maxs),
+            )
+            return CustomSqlState.merge(state, batch_state)
+
+        return ScanOps(
+            lambda: CustomSqlState.identity(k),
+            update,
+            CustomSqlState.merge,
+        )
+
+    def compute_metric_from_state(self, state) -> DoubleMetric:
+        if state is None:
+            return self.to_failure_metric(
+                EmptyStateException("Empty state for analyzer CustomSql.")
+            )
+        node, slots = self._plan()
+        values: Dict[_Slot, float] = {}
+        for i, (func, col) in enumerate(slots):
+            count = int(np.asarray(state.counts)[i])
+            if func == "SUM":
+                values[(func, col)] = float(np.asarray(state.sums)[i])
+            elif func == "COUNT":
+                values[(func, col)] = float(count)
+            elif func == "AVG":
+                if count == 0:
+                    return self.to_failure_metric(
+                        EmptyStateException(
+                            f"AVG({col}) over zero rows in CustomSql."
+                        )
+                    )
+                values[(func, col)] = float(np.asarray(state.sums)[i]) / count
+            elif func == "MIN":
+                if count == 0:
+                    return self.to_failure_metric(
+                        EmptyStateException(
+                            f"MIN({col}) over zero rows in CustomSql."
+                        )
+                    )
+                values[(func, col)] = float(np.asarray(state.mins)[i])
+            else:  # MAX
+                if count == 0:
+                    return self.to_failure_metric(
+                        EmptyStateException(
+                            f"MAX({col}) over zero rows in CustomSql."
+                        )
+                    )
+                values[(func, col)] = float(np.asarray(state.maxs)[i])
+        try:
+            result = _finalize(node, values)
+        except Exception as exc:  # noqa: BLE001
+            return self.to_failure_metric(exc)
+        return DoubleMetric.success(
+            self.entity, "CustomSql", self.instance, float(result)
+        )

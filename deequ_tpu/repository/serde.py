@@ -23,6 +23,7 @@ from deequ_tpu.analyzers import (
     Compliance,
     Correlation,
     CountDistinct,
+    CustomSql,
     DataType,
     Distinctness,
     Entropy,
@@ -74,6 +75,7 @@ ANALYZER_REGISTRY: Dict[str, Type[Analyzer]] = {
         Compliance,
         Correlation,
         CountDistinct,
+        CustomSql,
         DataType,
         Distinctness,
         Entropy,

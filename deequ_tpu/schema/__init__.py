@@ -1,0 +1,23 @@
+from deequ_tpu.schema.validator import (
+    ColumnDefinition,
+    DecimalColumnDefinition,
+    FractionalColumnDefinition,
+    IntColumnDefinition,
+    RowLevelSchema,
+    RowLevelSchemaValidationResult,
+    RowLevelSchemaValidator,
+    StringColumnDefinition,
+    TimestampColumnDefinition,
+)
+
+__all__ = [
+    "ColumnDefinition",
+    "DecimalColumnDefinition",
+    "FractionalColumnDefinition",
+    "IntColumnDefinition",
+    "RowLevelSchema",
+    "RowLevelSchemaValidationResult",
+    "RowLevelSchemaValidator",
+    "StringColumnDefinition",
+    "TimestampColumnDefinition",
+]

@@ -166,6 +166,11 @@ class Like(Node):
 
 
 @dataclass(frozen=True)
+class StarLit(Node):
+    """The `*` inside COUNT(*) (aggregate expressions only)."""
+
+
+@dataclass(frozen=True)
 class FuncCall(Node):
     name: str
     args: Tuple[Node, ...]
@@ -324,7 +329,10 @@ class _Parser:
         if tok.kind == "ident":
             if self.accept("op", "("):
                 args: List[Node] = []
-                if not self.accept("op", ")"):
+                if tok.text.upper() == "COUNT" and self.accept("op", "*"):
+                    args.append(StarLit())  # COUNT(*) only
+                    self.expect("op", ")")
+                elif not self.accept("op", ")"):
                     args.append(self.or_expr())
                     while self.accept("op", ","):
                         args.append(self.or_expr())
@@ -487,7 +495,19 @@ def _check_types(node: Node, schema) -> str:
                 raise PredicateParseError("LIKE requires a string column")
             return "value"
         if isinstance(n, FuncCall):
+            # the predicate evaluator supports only these functions;
+            # aggregates (SUM/COUNT/...) belong to CustomSql expressions
+            # and must fail HERE (planning time), not mid-trace where
+            # they would poison every co-scheduled analyzer
+            if n.name not in ("ABS", "LENGTH"):
+                raise PredicateParseError(
+                    f"unsupported function {n.name} in a predicate"
+                )
             for a in n.args:
+                if isinstance(a, StarLit):
+                    raise PredicateParseError(
+                        f"* is not a valid argument to {n.name}"
+                    )
                 kind_of(a)
             return "value"
         if isinstance(n, BinOp):

@@ -1,0 +1,137 @@
+"""Row-level verification results: per-row pass/fail per constraint.
+
+Reference: newer-upstream row-level results (SURVEY.md §2.2
+"FilteredRowOutcome", ``VerificationResult.rowLevelResultsAsDataFrame``):
+row-level-capable analyzers also emit a per-row boolean outcome column.
+Supported here: Completeness, Compliance (and every Check method that
+compiles to it: is_contained_in, is_non_negative, satisfies, ...),
+PatternMatch (and contains_email/url/...), Uniqueness. Rows excluded by
+a ``where`` filter count as passing (the reference's default
+FilteredRowOutcome is non-failing).
+
+Outcomes are computed vectorized — device ops for predicate/mask work,
+one host ``np.unique`` pass for uniqueness — never per-row Python.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import pyarrow as pa
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.analyzers.basic import Completeness, Compliance, PatternMatch
+from deequ_tpu.analyzers.grouping import Uniqueness
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
+from deequ_tpu.constraints.constraint import (
+    AnalysisBasedConstraint,
+    ConstraintDecorator,
+)
+from deequ_tpu.sql.predicate import compile_predicate
+
+
+def _full_batch(data: Dataset, requests) -> Dict[str, np.ndarray]:
+    batch = {r.key: data.materialize(r) for r in requests}
+    for r in requests:
+        mask_key = f"{r.column}::mask"
+        if mask_key not in batch:
+            batch[mask_key] = data.materialize(
+                ColumnRequest(r.column, "mask")
+            )
+    batch[ROW_MASK] = np.ones(data.num_rows, dtype=bool)
+    return batch
+
+
+def _where_pass(where: Optional[str], data: Dataset) -> Optional[np.ndarray]:
+    """True for rows EXCLUDED by the filter (they pass by default)."""
+    if where is None:
+        return None
+    pred = compile_predicate(where, data)
+    batch = _full_batch(data, pred.requests)
+    return ~np.asarray(jax.device_get(pred.complies(batch)), dtype=bool)
+
+
+def _outcome_for(analyzer: Analyzer, data: Dataset) -> Optional[np.ndarray]:
+    if isinstance(analyzer, Completeness):
+        mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
+        out = np.asarray(mask, dtype=bool).copy()
+    elif isinstance(analyzer, Compliance):
+        pred = compile_predicate(analyzer.predicate, data)
+        batch = _full_batch(data, pred.requests)
+        out = np.asarray(
+            jax.device_get(pred.complies(batch)), dtype=bool
+        ).copy()
+    elif isinstance(analyzer, PatternMatch):
+        import re
+
+        codes = data.materialize(ColumnRequest(analyzer.column, "codes"))
+        mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
+        dictionary = data.dictionary(analyzer.column)
+        prog = re.compile(analyzer.pattern)
+        lut = np.zeros(max(len(dictionary), 1) + 1, dtype=bool)
+        for i, value in enumerate(dictionary):
+            if value is not None and prog.search(str(value)):
+                lut[i] = True
+        idx = np.where(codes < 0, len(lut) - 1, codes)
+        out = lut[np.clip(idx, 0, len(lut) - 1)] & np.asarray(
+            mask, dtype=bool
+        )
+    elif isinstance(analyzer, Uniqueness):
+        columns = analyzer.grouping_columns()
+        # fold columns into one exact group id via successive np.unique
+        # in each column's NATIVE dtype — no float64 cast (int64 ids
+        # above 2^53 must stay distinct, exactly like the HLL hashing)
+        group_ids: Optional[np.ndarray] = None
+        for c in columns:
+            kind = data.schema.kind_of(c)
+            repr_name = "codes" if kind == Kind.STRING else "values"
+            values = np.asarray(data.materialize(ColumnRequest(c, repr_name)))
+            mask = np.asarray(
+                data.materialize(ColumnRequest(c, "mask")), dtype=bool
+            )
+            _, col_ids = np.unique(values, return_inverse=True)
+            # validity joins the key so NULL is its own value,
+            # distinct from the zero-fill
+            col_ids = col_ids * 2 + mask.astype(np.int64)
+            if group_ids is None:
+                group_ids = col_ids
+            else:
+                pair = np.stack([group_ids, col_ids], axis=1)
+                _, group_ids = np.unique(
+                    pair, axis=0, return_inverse=True
+                )
+        _, inverse, counts = np.unique(
+            group_ids, return_inverse=True, return_counts=True
+        )
+        out = counts[inverse] == 1
+    else:
+        return None
+
+    excluded = _where_pass(getattr(analyzer, "where", None), data)
+    if excluded is not None:
+        out = out | excluded
+    return out
+
+
+def row_level_results(check_results, data: Dataset) -> Dataset:
+    """One boolean column per row-level-capable constraint, named by the
+    constraint, over ``data`` (the dataset the suite ran on)."""
+    columns: Dict[str, pa.Array] = {}
+    for check, result in check_results.items():
+        for cr in result.constraint_results:
+            constraint = cr.constraint
+            if isinstance(constraint, ConstraintDecorator):
+                inner = constraint.inner
+            else:
+                inner = constraint
+            if not isinstance(inner, AnalysisBasedConstraint):
+                continue
+            outcome = _outcome_for(inner.analyzer, data)
+            if outcome is None:
+                continue
+            columns[str(constraint)] = pa.array(outcome)
+    if not columns:
+        return Dataset(pa.table({"__no_row_level_constraints__": pa.array([], pa.bool_())}))
+    return Dataset(pa.table(columns))

@@ -36,10 +36,28 @@ class VerificationResult:
         status: CheckStatus,
         check_results: Dict[Check, CheckResult],
         metrics: Dict[Analyzer, Metric],
+        data: Optional[Dataset] = None,
     ):
         self.status = status
         self.check_results = check_results
         self.metrics = metrics
+        self._data = data  # for row-level results; None on state-only runs
+
+    def row_level_results_as_dataset(
+        self, data: Optional[Dataset] = None
+    ) -> Dataset:
+        """Per-row pass/fail per row-level-capable constraint (reference:
+        rowLevelResultsAsDataFrame — SURVEY.md §2.2). Pass ``data``
+        explicitly for runs evaluated from aggregated states."""
+        from deequ_tpu.verification.rowlevel import row_level_results
+
+        target = data if data is not None else self._data
+        if target is None:
+            raise ValueError(
+                "row-level results need the dataset; this result was "
+                "computed without one (state-only run) — pass data="
+            )
+        return row_level_results(self.check_results, target)
 
     # -- exporters (reference: VerificationResult companion object) -----
 
@@ -108,7 +126,7 @@ class VerificationSuite:
             fail_if_results_missing=fail_if_results_missing,
             save_or_append_results_with_key=save_or_append_results_with_key,
         )
-        return VerificationSuite.evaluate(checks, context)
+        return VerificationSuite.evaluate(checks, context, data=data)
 
     @staticmethod
     def run_on_aggregated_states(
@@ -128,7 +146,9 @@ class VerificationSuite:
 
     @staticmethod
     def evaluate(
-        checks: Sequence[Check], context: AnalyzerContext
+        checks: Sequence[Check],
+        context: AnalyzerContext,
+        data: Optional[Dataset] = None,
     ) -> VerificationResult:
         check_results = {check: check.evaluate(context) for check in checks}
         if not check_results:
@@ -139,7 +159,9 @@ class VerificationSuite:
                 key=lambda s: ["Success", "Warning", "Error"].index(s.value),
             )
             status = worst
-        return VerificationResult(status, check_results, context.metric_map)
+        return VerificationResult(
+            status, check_results, context.metric_map, data=data
+        )
 
 
 class VerificationRunBuilder:

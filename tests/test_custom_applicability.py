@@ -1,0 +1,166 @@
+"""CustomSql aggregate expressions, Applicability checking, and
+row-level results (SURVEY.md §2.2 CustomSql, §1 L12 applicability,
+§2.2 rowLevelResultsAsDataFrame)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import (
+    Applicability,
+    Check,
+    CheckLevel,
+    Compliance,
+    CustomSql,
+    Dataset,
+    Mean,
+    PatternMatch,
+    Size,
+    Uniqueness,
+    VerificationSuite,
+)
+from deequ_tpu.analyzers import AnalysisRunner
+
+
+def value(analyzer, ds):
+    return analyzer.calculate(ds).value.get()
+
+
+class TestCustomSql:
+    @pytest.fixture(scope="class")
+    def ds(self):
+        return Dataset.from_pydict(
+            {
+                "a": [1.0, 2.0, 3.0, 4.0],
+                "b": [2.0, 2.0, 2.0, None],
+                "s": ["x", "y", "x", "z"],
+            }
+        )
+
+    def test_basic_aggregates(self, ds):
+        assert value(CustomSql("SUM(a)"), ds) == 10.0
+        assert value(CustomSql("COUNT(*)"), ds) == 4.0
+        assert value(CustomSql("COUNT(b)"), ds) == 3.0  # nulls skipped
+        assert value(CustomSql("AVG(a)"), ds) == 2.5
+        assert value(CustomSql("MIN(a)"), ds) == 1.0
+        assert value(CustomSql("MAX(a)"), ds) == 4.0
+
+    def test_arithmetic_composition(self, ds):
+        assert value(CustomSql("SUM(a) / SUM(b)"), ds) == pytest.approx(
+            10.0 / 6.0
+        )
+        assert value(
+            CustomSql("AVG(a) * 2 + MIN(a) - 1"), ds
+        ) == pytest.approx(5.0)
+        assert value(CustomSql("SUM(a) / COUNT(*)"), ds) == 2.5
+
+    def test_where_filter(self, ds):
+        assert value(CustomSql("SUM(a)", where="a > 2"), ds) == 7.0
+        assert value(CustomSql("COUNT(*)", where="a > 2"), ds) == 2.0
+
+    def test_incremental_merge(self, ds):
+        """The state merges monoidally like every other analyzer."""
+        a = CustomSql("SUM(a) / COUNT(*)")
+        ops = a.make_ops(ds)
+        half1 = Dataset.from_pydict({"a": [1.0, 2.0], "b": [1.0, 1.0]})
+        half2 = Dataset.from_pydict({"a": [3.0, 4.0], "b": [1.0, 1.0]})
+        s1 = AnalysisRunner.do_analysis_run(half1, [a]).metric(a)
+        # merge states through the engine path
+        from deequ_tpu.engine import AnalysisEngine
+
+        engine = AnalysisEngine()
+        st1 = engine.run_scan(half1, [(a, a.make_ops(half1))])[0]
+        st2 = engine.run_scan(half2, [(a, a.make_ops(half2))])[0]
+        merged = type(st1).merge(st1, st2)
+        assert a.compute_metric_from_state(merged).value.get() == 2.5
+
+    def test_failure_modes(self, ds):
+        assert CustomSql("SUM(nope)").calculate(ds).value.is_failure
+        assert CustomSql("a + 1").calculate(ds).value.is_failure  # bare col
+        assert CustomSql("SUM(s)").calculate(ds).value.is_failure  # string
+        assert CustomSql("1 + 2").calculate(ds).value.is_failure  # no agg
+        assert CustomSql(
+            "SUM(a) / SUM(a) - SUM(a) / SUM(a) + SUM(a) / (SUM(a) - SUM(a))"
+        ).calculate(ds).value.is_failure  # div by zero
+
+    def test_shares_the_fused_scan(self, ds):
+        from deequ_tpu.engine import AnalysisEngine
+
+        engine = AnalysisEngine()
+        ctx = AnalysisRunner.do_analysis_run(
+            ds, [CustomSql("SUM(a)"), Mean("a"), Size()], engine=engine
+        )
+        assert engine.trace_count == 1
+        assert ctx.metric(CustomSql("SUM(a)")).value.get() == 10.0
+
+
+class TestApplicability:
+    def test_check_applicability(self):
+        ds = Dataset.from_pydict({"x": [1.0], "s": ["a"]})
+        schema = ds.schema
+        good = (
+            Check(CheckLevel.ERROR, "good")
+            .is_complete("x")
+            .has_mean("x", lambda m: m > 0)
+        )
+        result = Applicability().is_applicable(good, schema)
+        assert result.is_applicable
+        bad = Check(CheckLevel.ERROR, "bad").has_mean("s", lambda m: m > 0)
+        result = Applicability().is_applicable(bad, schema)
+        assert not result.is_applicable
+        assert any(v is not None for v in result.failures.values())
+
+    def test_analyzer_applicability(self):
+        ds = Dataset.from_pydict({"x": [1.0]})
+        result = Applicability().are_applicable(
+            [Mean("x"), Mean("missing")], ds.schema
+        )
+        assert not result.is_applicable
+        assert result.failures[repr(Mean("x"))] is None
+        assert result.failures[repr(Mean("missing"))] is not None
+
+
+class TestRowLevelResults:
+    def test_row_level_outcomes(self):
+        ds = Dataset.from_pydict(
+            {
+                "x": [1.0, -2.0, 3.0, None],
+                "id": [1, 2, 2, 4],
+                "email": ["a@b.com", "nope", "c@d.org", None],
+            }
+        )
+        check = (
+            Check(CheckLevel.ERROR, "rl")
+            .is_complete("x")
+            .satisfies("x > 0", "positive", lambda v: v == 1.0)
+            .is_unique("id")
+            .contains_email("email", lambda v: v == 1.0)
+        )
+        result = VerificationSuite().on_data(ds).add_check(check).run()
+        rl = result.row_level_results_as_dataset().table
+        assert rl.num_rows == 4
+        by_name = {
+            name: rl.column(name).to_pylist() for name in rl.schema.names
+        }
+        completeness = next(
+            v for k, v in by_name.items() if "Completeness" in k
+        )
+        assert completeness == [True, True, True, False]
+        positive = next(v for k, v in by_name.items() if "positive" in k)
+        assert positive == [True, False, True, False]
+        unique = next(v for k, v in by_name.items() if "Uniqueness" in k)
+        assert unique == [True, False, False, True]
+        email = next(v for k, v in by_name.items() if "email" in k.lower())
+        assert email == [True, False, True, False]
+
+    def test_where_filtered_rows_pass(self):
+        ds = Dataset.from_pydict({"x": [1.0, -5.0, 2.0], "g": [1, 2, 1]})
+        check = (
+            Check(CheckLevel.ERROR, "w")
+            .satisfies("x > 0", "pos-in-g1", lambda v: v == 1.0)
+            .where("g = 1")
+        )
+        result = VerificationSuite().on_data(ds).add_check(check).run()
+        rl = result.row_level_results_as_dataset().table
+        col = rl.column(rl.schema.names[0]).to_pylist()
+        # row 1 is excluded by the filter -> passes by default
+        assert col == [True, True, True]

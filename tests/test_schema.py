@@ -1,0 +1,130 @@
+"""Row-level schema validation tests (reference test model:
+RowLevelSchemaValidatorTest — SURVEY.md §1 L11, §2.5)."""
+
+import pyarrow as pa
+import pytest
+
+from deequ_tpu import Dataset
+from deequ_tpu.schema import (
+    RowLevelSchema,
+    RowLevelSchemaValidator,
+)
+
+
+class TestRowLevelSchemaValidator:
+    def test_mixed_csv_style_validation(self):
+        """The reference's canonical example: all-string input, typed
+        schema, split into typed-valid and raw-invalid rows."""
+        ds = Dataset.from_pydict(
+            {
+                "id": ["1", "2", "three", "4", None],
+                "name": ["a", "bb", "ccc", None, "e"],
+                "ts": [
+                    "2024-01-01 00:00:00",
+                    "2024-06-15 12:30:00",
+                    "2024-01-01 00:00:00",
+                    "not a date",
+                    "2024-01-01 00:00:00",
+                ],
+            }
+        )
+        schema = (
+            RowLevelSchema()
+            .with_int_column("id", is_nullable=False)
+            .with_string_column("name", is_nullable=True, max_length=2)
+            .with_timestamp_column("ts", mask="yyyy-MM-dd HH:mm:ss")
+        )
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        # row0 ok; row1 ok; row2 id unparseable + name too long;
+        # row3 bad ts; row4 id null (non-nullable)
+        assert result.num_valid_rows == 2
+        assert result.num_invalid_rows == 3
+        valid = result.valid_rows.table
+        assert pa.types.is_integer(valid.column("id").type)
+        assert pa.types.is_timestamp(valid.column("ts").type)
+        assert valid.column("id").to_pylist() == [1, 2]
+        # invalid rows keep the RAW values for debugging
+        invalid = result.invalid_rows.table
+        assert invalid.column("id").to_pylist() == ["three", "4", None]
+
+    def test_int_bounds(self):
+        ds = Dataset.from_pydict({"x": ["5", "15", "-3", "7"]})
+        schema = RowLevelSchema().with_int_column(
+            "x", min_value=0, max_value=10
+        )
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        assert result.valid_rows.table.column("x").to_pylist() == [5, 7]
+
+    def test_string_regex_and_lengths(self):
+        ds = Dataset.from_pydict(
+            {"code": ["AB-1", "XY-2", "bad", "AB-33", None]}
+        )
+        schema = RowLevelSchema().with_string_column(
+            "code",
+            is_nullable=False,
+            min_length=4,
+            max_length=5,
+            matches=r"^[A-Z]{2}-\d+$",
+        )
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        assert result.valid_rows.table.column("code").to_pylist() == [
+            "AB-1",
+            "XY-2",
+            "AB-33",
+        ]
+
+    def test_nullable_semantics(self):
+        ds = Dataset.from_pydict({"x": ["1", None, "2"]})
+        nullable = RowLevelSchema().with_int_column("x", is_nullable=True)
+        strict = RowLevelSchema().with_int_column("x", is_nullable=False)
+        assert RowLevelSchemaValidator.validate(ds, nullable).num_valid_rows == 3
+        assert RowLevelSchemaValidator.validate(ds, strict).num_valid_rows == 2
+
+    def test_decimal_precision_scale(self):
+        ds = Dataset.from_pydict(
+            {"d": ["12.34", "1.2", "123.45", "1.234", "x"]}
+        )
+        schema = RowLevelSchema().with_decimal_column(
+            "d", precision=4, scale=2
+        )
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        # 123.45 has 3 integer digits (> precision-scale=2); 1.234 scale 3
+        assert result.valid_rows.table.column("d").to_pylist() == [
+            pytest.approx(12.34),
+            pytest.approx(1.2),
+        ]
+
+    def test_fractional_column(self):
+        ds = Dataset.from_pydict({"f": ["1.5", "2", "abc", "1e3"]})
+        schema = RowLevelSchema().with_fractional_column(
+            "f", is_nullable=False
+        )
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        assert result.valid_rows.table.column("f").to_pylist() == [
+            1.5,
+            2.0,
+            1000.0,
+        ]
+
+    def test_typed_input_passthrough(self):
+        """Already-typed columns validate on nullability alone."""
+        ds = Dataset.from_pydict({"x": [1, 2, None]})
+        schema = RowLevelSchema().with_int_column("x", is_nullable=False)
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        assert result.num_valid_rows == 2
+
+    def test_unknown_column_raises(self):
+        ds = Dataset.from_pydict({"x": [1]})
+        with pytest.raises(KeyError):
+            RowLevelSchemaValidator.validate(
+                ds, RowLevelSchema().with_int_column("nope")
+            )
+
+    def test_undeclared_columns_pass_through(self):
+        ds = Dataset.from_pydict({"x": ["1", "2"], "extra": ["p", "q"]})
+        schema = RowLevelSchema().with_int_column("x")
+        result = RowLevelSchemaValidator.validate(ds, schema)
+        assert result.valid_rows.table.column("extra").to_pylist() == [
+            "p",
+            "q",
+        ]
