@@ -182,6 +182,16 @@ class Dataset:
     def from_pydict(data: Dict[str, Sequence]) -> "Dataset":
         return Dataset(pa.table(data))
 
+    @staticmethod
+    def from_parquet(source, read_batch_rows: int = 1 << 20) -> "Dataset":
+        """Streaming parquet-backed dataset: batches are read and
+        converted on the fly; whole columns are never materialized on
+        the host unless the resident device cache opts in (see
+        deequ_tpu.data.parquet)."""
+        from deequ_tpu.data.parquet import ParquetDataset
+
+        return ParquetDataset(source, read_batch_rows)
+
     # -- metadata -------------------------------------------------------
 
     @property
