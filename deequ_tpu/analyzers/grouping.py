@@ -46,7 +46,23 @@ from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
 from deequ_tpu.sql.predicate import compile_predicate
 
 NULL_VALUE = "NullValue"  # reference: Histogram's bin name for nulls
-MAX_DENSE_JOINT = 1 << 24  # dense device count-vector cap
+MAX_DENSE_JOINT = 1 << 24  # dense cap floor when no budget is configured
+
+
+def _dense_joint_cap(num_rows: int) -> Tuple[int, "np.dtype"]:
+    """(max COMBINED joint key space, count dtype) for the dense device
+    path. The cap follows the configured grouping budget exactly (a
+    small budget on a memory-constrained device must be honored); count
+    vectors are i32 when every per-key count provably fits
+    (num_rows < 2^31), which doubles the affordable key space
+    (~2^28 keys per GB)."""
+    from deequ_tpu import config
+
+    budget = config.options().dense_grouping_budget_bytes
+    dtype = np.int32 if num_rows < 2**31 else np.int64
+    if not budget:
+        return MAX_DENSE_JOINT, dtype
+    return max(1, budget // np.dtype(dtype).itemsize), dtype
 
 
 # --------------------------------------------------------------------------
@@ -67,14 +83,46 @@ class FrequenciesAndNumRows:
     def __init__(
         self,
         columns: Tuple[str, ...],
-        keys: np.ndarray,
+        keys: Optional[np.ndarray],
         counts: np.ndarray,
         num_rows: int,
+        lazy_codes: Optional[Tuple] = None,
     ):
+        """``keys`` may be None with ``lazy_codes=(observed_codes,
+        dictionaries, sizes)``: count-only metrics (Uniqueness,
+        Distinctness, CountDistinct) never touch key VALUES, and
+        decoding 10M joint codes into object arrays costs seconds —
+        so decoding happens on first ``.keys`` access only."""
         self.columns = tuple(columns)
-        self.keys = keys
+        self._keys = keys
+        self._lazy = lazy_codes
         self.counts = np.asarray(counts, dtype=np.int64)
         self.num_rows = int(num_rows)
+
+    @property
+    def keys(self) -> np.ndarray:
+        if self._keys is None:
+            observed, dictionaries, sizes = self._lazy
+            self._keys = _decode_joint_codes(
+                len(self.columns), observed, dictionaries, sizes
+            )
+        return self._keys
+
+    def non_null_group_mask(self) -> np.ndarray:
+        """True where NO key column is null — computable straight from
+        the joint codes (slot 0 = null) without decoding values."""
+        if self._lazy is not None:
+            observed, _, sizes = self._lazy
+            remaining = observed.copy()
+            mask = np.ones(len(observed), dtype=bool)
+            for j in range(len(self.columns) - 1, -1, -1):
+                slot = remaining % sizes[j]
+                remaining = remaining // sizes[j]
+                mask &= slot > 0
+            return mask
+        # eager keys (spill-path states can hold 100M+ groups): a
+        # vectorized object comparison, not a per-row Python loop
+        return ~np.equal(self.keys, None).any(axis=1)
 
     @property
     def num_groups(self) -> int:
@@ -84,27 +132,46 @@ class FrequenciesAndNumRows:
     def merge(
         a: "FrequenciesAndNumRows", b: "FrequenciesAndNumRows"
     ) -> "FrequenciesAndNumRows":
+        """Vectorized union+sum via Arrow's multithreaded group_by — the
+        reference merges frequency DataFrames with unionByName +
+        groupBy.sum (SURVEY.md §3.2); a Python dict loop here would crawl
+        on multi-million-key states."""
         if a.columns != b.columns:
             raise ValueError(
                 f"cannot merge frequencies over {a.columns} with {b.columns}"
             )
-        combined: Dict[Tuple, int] = {}
-        for keys, counts in ((a.keys, a.counts), (b.keys, b.counts)):
-            for row, count in zip(keys, counts):
-                key = tuple(row)
-                combined[key] = combined.get(key, 0) + int(count)
-        if combined:
-            key_arr = np.empty((len(combined), len(a.columns)), dtype=object)
-            for i, key in enumerate(combined):
-                key_arr[i, :] = key
-            count_arr = np.fromiter(
-                combined.values(), dtype=np.int64, count=len(combined)
+        columns = list(a.columns)
+        if a.num_groups == 0 and b.num_groups == 0:
+            return FrequenciesAndNumRows(
+                a.columns,
+                np.empty((0, len(columns)), dtype=object),
+                np.zeros(0, dtype=np.int64),
+                a.num_rows + b.num_rows,
             )
-        else:
-            key_arr = np.empty((0, len(a.columns)), dtype=object)
-            count_arr = np.zeros(0, dtype=np.int64)
+        data = {}
+        for j, c in enumerate(columns):
+            data[c] = pa.array(
+                np.concatenate([a.keys[:, j], b.keys[:, j]]).tolist()
+            )
+        data["__count__"] = pa.array(
+            np.concatenate([a.counts, b.counts]), pa.int64()
+        )
+        grouped = (
+            pa.table(data).group_by(columns).aggregate([("__count__", "sum")])
+        )
+        counts = grouped.column("__count___sum").to_numpy(
+            zero_copy_only=False
+        )
+        key_arr = np.empty((len(counts), len(columns)), dtype=object)
+        for j, c in enumerate(columns):
+            key_arr[:, j] = np.asarray(
+                grouped.column(c).to_pylist(), dtype=object
+            )
         return FrequenciesAndNumRows(
-            a.columns, key_arr, count_arr, a.num_rows + b.num_rows
+            a.columns,
+            key_arr,
+            counts.astype(np.int64),
+            a.num_rows + b.num_rows,
         )
 
 
@@ -141,20 +208,35 @@ def compute_many_frequencies(
     single job, SURVEY.md §7 hard part #6). Plans whose joint key space
     exceeds the dense cap fall back to Arrow's host group_by."""
     engine = engine or AnalysisEngine()
+    cap, count_dtype = _dense_joint_cap(dataset.num_rows)
     dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]] = []
     results: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
+    # the cap bounds the COMBINED key space: all dense plans ride one
+    # fused scan, so their count vectors are live on device together
+    remaining = cap
     for plan in plans:
-        dictionaries = [dataset.dictionary(c) for c in plan.columns]
-        sizes = [len(d) + 1 for d in dictionaries]  # +1: the null slot
+        # capped distinct counts first: a spilling plan must never
+        # materialize an unbounded value set on the host
+        sizes_maybe = [
+            dataset.dictionary_size_within(c, cap) for c in plan.columns
+        ]
         joint = 1
-        for s in sizes:
-            joint *= s
-        if joint <= MAX_DENSE_JOINT:
+        for s in sizes_maybe:
+            if s is None:
+                joint = None
+                break
+            joint *= s + 1  # +1: the null slot
+        if joint is not None and joint <= remaining:
+            dictionaries = [dataset.dictionary(c) for c in plan.columns]
+            sizes = [len(d) + 1 for d in dictionaries]
             dense.append((plan, dictionaries, sizes))
+            remaining -= joint
         else:
             results[plan] = _arrow_frequencies(dataset, plan)
     if dense:
-        results.update(_device_frequencies_shared(dataset, dense, engine))
+        results.update(
+            _device_frequencies_shared(dataset, dense, engine, count_dtype)
+        )
     return results
 
 
@@ -173,9 +255,11 @@ def _make_dense_ops(
     dataset: Dataset,
     plan: FrequencyPlan,
     sizes: List[int],
+    count_dtype=np.int64,
 ):
-    """(requests, ScanOps) for one dense frequency plan; the ops' state is
-    (dense int64 count vector, kept-row count)."""
+    """(requests, ScanOps) for one dense frequency plan; the ops' state
+    is (dense count vector, kept-row count). The count vector dtype is
+    i32 when every count provably fits (see _dense_joint_cap)."""
     from deequ_tpu.analyzers.base import ScanOps
 
     columns = list(plan.columns)
@@ -191,10 +275,13 @@ def _make_dense_ops(
     joint = 1
     for s in sizes:
         joint *= s
+    jnp_count = jnp.int32 if count_dtype == np.int32 else jnp.int64
+    # joint codes need int64 lanes once the key space passes 2^31
+    code_dtype = jnp.int64 if joint >= 2**31 else jnp.int32
 
     def init():
         return (
-            np.zeros(joint, dtype=np.int64),
+            np.zeros(joint, dtype=count_dtype),
             np.int64(0),
         )
 
@@ -210,19 +297,42 @@ def _make_dense_ops(
             for c in columns:
                 any_non_null = any_non_null | batch[f"{c}::mask"]
             keep = rows & any_non_null
-        code = jnp.zeros_like(batch[f"{columns[0]}::codes"])
+        code = jnp.zeros(
+            batch[f"{columns[0]}::codes"].shape, dtype=code_dtype
+        )
         for c, size in zip(columns, sizes):
-            shifted = batch[f"{c}::codes"] + 1  # null (-1) -> slot 0
-            code = code * size + shifted
+            shifted = (batch[f"{c}::codes"] + 1).astype(code_dtype)
+            code = code * size + shifted  # null (-1) -> slot 0
         # masked scatter-add; rejected rows go to an overflow slot
         code = jnp.where(keep, code, joint)
         counts = counts + jnp.bincount(
             code, length=joint + 1
-        )[:joint].astype(jnp.int64)
+        )[:joint].astype(jnp_count)
         return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
 
     ops = ScanOps(init, update, lambda a, b: (a[0] + b[0], a[1] + b[1]))
     return requests, ops
+
+
+def _decode_joint_codes(
+    n_columns: int,
+    observed: np.ndarray,
+    dictionaries: List[np.ndarray],
+    sizes: List[int],
+) -> np.ndarray:
+    key_arr = np.empty((len(observed), n_columns), dtype=object)
+    remaining = observed.copy()
+    for j in range(n_columns - 1, -1, -1):
+        slot = remaining % sizes[j]
+        remaining = remaining // sizes[j]
+        dictionary = dictionaries[j]
+        decoded = np.empty(len(slot), dtype=object)
+        non_null = slot > 0
+        if non_null.any():
+            decoded[non_null] = dictionary[slot[non_null] - 1]
+        decoded[~non_null] = None
+        key_arr[:, j] = decoded
+    return key_arr
 
 
 def _decode_dense(
@@ -234,20 +344,12 @@ def _decode_dense(
 ) -> FrequenciesAndNumRows:
     columns = list(plan.columns)
     observed = np.nonzero(counts)[0]
-    key_arr = np.empty((len(observed), len(columns)), dtype=object)
-    remaining = observed.copy()
-    for j in range(len(columns) - 1, -1, -1):
-        slot = remaining % sizes[j]
-        remaining = remaining // sizes[j]
-        dictionary = dictionaries[j]
-        decoded = np.empty(len(slot), dtype=object)
-        non_null = slot > 0
-        if non_null.any():
-            decoded[non_null] = dictionary[slot[non_null] - 1]
-        decoded[~non_null] = None
-        key_arr[:, j] = decoded
     return FrequenciesAndNumRows(
-        tuple(columns), key_arr, counts[observed], num_rows
+        tuple(columns),
+        None,
+        counts[observed],
+        num_rows,
+        lazy_codes=(observed, list(dictionaries), list(sizes)),
     )
 
 
@@ -255,6 +357,7 @@ def _device_frequencies_shared(
     dataset: Dataset,
     dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]],
     engine: AnalysisEngine,
+    count_dtype=np.int64,
 ) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
     class _FreqAnalyzer:
         """Adapter so frequency passes ride the shared scan engine."""
@@ -267,7 +370,7 @@ def _device_frequencies_shared(
 
     planned = []
     for plan, dictionaries, sizes in dense:
-        requests, ops = _make_dense_ops(dataset, plan, sizes)
+        requests, ops = _make_dense_ops(dataset, plan, sizes, count_dtype)
         planned.append((_FreqAnalyzer(requests), ops))
     states = engine.run_scan(dataset, planned)  # type: ignore[arg-type]
     out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
@@ -278,12 +381,72 @@ def _device_frequencies_shared(
     return out
 
 
+def _frequencies_of_table(
+    columns: List[str], table: pa.Table
+) -> FrequenciesAndNumRows:
+    grouped = table.group_by(columns).aggregate([([], "count_all")])
+    counts = grouped.column("count_all").to_numpy(zero_copy_only=False)
+    key_arr = np.empty((len(counts), len(columns)), dtype=object)
+    for j, c in enumerate(columns):
+        key_arr[:, j] = np.asarray(grouped.column(c).to_pylist(), dtype=object)
+    return FrequenciesAndNumRows(
+        tuple(columns), key_arr, counts.astype(np.int64), int(table.num_rows)
+    )
+
+
 def _arrow_frequencies(
     dataset: Dataset, plan: FrequencyPlan
 ) -> FrequenciesAndNumRows:
     """Host fallback for huge joint key spaces: Arrow's multithreaded
-    C++ group_by (the 'spill' strategy of SURVEY.md §7 hard part #1)."""
+    C++ group_by (the 'spill' strategy of SURVEY.md §7 hard part #1).
+    Without a where-filter this STREAMS record batches — group_by per
+    chunk, then the vectorized sparse merge — so memory is O(chunk +
+    distinct), and parquet sources are never fully loaded."""
     columns = list(plan.columns)
+    if plan.where is None:
+        # group each chunk in Arrow, stash the (small) grouped tables,
+        # and run ONE final group_by over their concatenation — keys
+        # never round-trip through Python objects, and the cost is
+        # O(rows + total_partial_groups), not O(chunks x distinct)
+        parts: List[pa.Table] = []
+        num_rows = 0
+        for record_batch in dataset.record_batches(columns):
+            table = pa.Table.from_batches([record_batch])
+            if not plan.include_nulls:
+                non_null = np.zeros(table.num_rows, dtype=bool)
+                for c in columns:
+                    col = table.column(c)
+                    non_null |= ~np.asarray(
+                        col.is_null().combine_chunks()
+                    )
+                table = table.filter(pa.array(non_null))
+            num_rows += table.num_rows
+            parts.append(
+                table.group_by(columns).aggregate([([], "count_all")])
+            )
+        if not parts:
+            return FrequenciesAndNumRows(
+                tuple(columns),
+                np.empty((0, len(columns)), dtype=object),
+                np.zeros(0, dtype=np.int64),
+                0,
+            )
+        combined = pa.concat_tables(parts)
+        grouped = combined.group_by(columns).aggregate(
+            [("count_all", "sum")]
+        )
+        counts = grouped.column("count_all_sum").to_numpy(
+            zero_copy_only=False
+        )
+        key_arr = np.empty((len(counts), len(columns)), dtype=object)
+        for j, c in enumerate(columns):
+            key_arr[:, j] = np.asarray(
+                grouped.column(c).to_pylist(), dtype=object
+            )
+        return FrequenciesAndNumRows(
+            tuple(columns), key_arr, counts.astype(np.int64), num_rows
+        )
+    # where-filter: the predicate needs full device reprs — materialize
     table = dataset.table.select(columns)
     mask = _where_mask_full(dataset, plan.where)
     if not plan.include_nulls:
@@ -293,14 +456,7 @@ def _arrow_frequencies(
         mask = non_null if mask is None else (mask & non_null)
     if mask is not None:
         table = table.filter(pa.array(mask))
-    grouped = table.group_by(columns).aggregate([([], "count_all")])
-    counts = grouped.column("count_all").to_numpy(zero_copy_only=False)
-    key_arr = np.empty((len(counts), len(columns)), dtype=object)
-    for j, c in enumerate(columns):
-        key_arr[:, j] = np.asarray(grouped.column(c).to_pylist(), dtype=object)
-    return FrequenciesAndNumRows(
-        tuple(columns), key_arr, counts.astype(np.int64), int(table.num_rows)
-    )
+    return _frequencies_of_table(columns, table)
 
 
 def run_grouping_analyzers(
@@ -436,10 +592,7 @@ class Entropy(_FrequencyAnalyzer):
     analyzers/Entropy.scala); computed over non-null groups."""
 
     def _value(self, state: FrequenciesAndNumRows) -> float:
-        non_null = np.array(
-            [all(v is not None for v in row) for row in state.keys], dtype=bool
-        )
-        counts = state.counts[non_null].astype(np.float64)
+        counts = state.counts[state.non_null_group_mask()].astype(np.float64)
         total = counts.sum()
         if total == 0:
             raise EmptyStateException("Entropy over empty distribution.")
@@ -462,9 +615,7 @@ class MutualInformation(_FrequencyAnalyzer):
         return Entity.MULTICOLUMN
 
     def _value(self, state: FrequenciesAndNumRows) -> float:
-        keep = np.array(
-            [all(v is not None for v in row) for row in state.keys], dtype=bool
-        )
+        keep = state.non_null_group_mask()
         keys = state.keys[keep]
         counts = state.counts[keep].astype(np.float64)
         total = counts.sum()

@@ -50,6 +50,12 @@ class Options:
     )
     # synthesize masks of all-valid columns on device (skip transfer)
     synthesize_all_true_masks: bool = True
+    # device budget for dense grouping count vectors (bytes); caps the
+    # joint key space the frequency pass keeps on device (~2^28 keys/GB
+    # at i32 counts) before spilling to the host Arrow group_by
+    dense_grouping_budget_bytes: int = int(
+        os.environ.get("DEEQU_TPU_DENSE_GROUPING_BYTES", 1 << 30)
+    )
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

@@ -36,7 +36,23 @@ from deequ_tpu.data.table import (
     ROW_MASK,
     Schema,
     _kind_of,
+    convert_basic_repr,
 )
+
+
+class _NanKey:
+    """Canonical dict key for float NaN (NaN != NaN, so raw NaN values
+    can never hit a dict entry; Arrow's dictionary_encode treats NaNs
+    as equal, and the in-memory path must agree with the parquet one)."""
+
+
+_NAN_KEY = _NanKey()
+
+
+def _canon_key(value):
+    if isinstance(value, float) and value != value:
+        return _NAN_KEY
+    return value
 
 
 def _column_batch_to_reprs(
@@ -45,50 +61,23 @@ def _column_batch_to_reprs(
     requests: List[str],
     code_map: Optional[Dict] = None,
 ) -> Dict[str, np.ndarray]:
-    """Convert one record-batch column into the requested device reprs
-    (mirrors Dataset.materialize, batch-local)."""
+    """Convert one record-batch column into the requested device reprs.
+    mask/values/lengths share Dataset.materialize's conversion rules
+    (table.convert_basic_repr); codes remap the batch-local dictionary
+    through the dataset-global code map."""
     out: Dict[str, np.ndarray] = {}
     for repr_name in requests:
-        if repr_name == "mask":
-            if column.null_count == 0:
-                arr = np.ones(len(column), dtype=bool)
-            else:
-                arr = ~np.asarray(column.is_null())
-            out["mask"] = np.ascontiguousarray(arr.astype(bool))
-        elif repr_name == "values":
-            if kind == Kind.STRING:
-                raise TypeError(
-                    "string columns have no 'values' repr; request "
-                    "'codes' or 'lengths' instead"
-                )
-            filled = column
-            if kind == Kind.TIMESTAMP:
-                filled = pc.cast(column, pa.int64())
-                if column.null_count:
-                    filled = pc.fill_null(filled, pa.scalar(0, pa.int64()))
-            elif column.null_count:
-                zero = (
-                    pa.scalar(False)
-                    if kind == Kind.BOOLEAN
-                    else pa.scalar(0, type=column.type)
-                )
-                filled = pc.fill_null(column, zero)
-            arr = filled.to_numpy(zero_copy_only=False)
-            if kind == Kind.BOOLEAN:
-                arr = arr.astype(np.int32)
-            elif arr.dtype == np.float16:
-                arr = arr.astype(np.float32)
-            elif arr.dtype.kind not in "iuf":
-                arr = arr.astype(np.float64)
-            out["values"] = np.ascontiguousarray(arr)
-        elif repr_name == "codes":
+        if repr_name == "codes":
             assert code_map is not None
             if pa.types.is_dictionary(column.type):
                 column = pc.cast(column, column.type.value_type)
             local = pc.dictionary_encode(column)
             local_dict = local.dictionary.to_pylist()
             lut = np.array(
-                [code_map.get(v, -1) if v is not None else -1 for v in local_dict]
+                [
+                    code_map.get(_canon_key(v), -1) if v is not None else -1
+                    for v in local_dict
+                ]
                 + [-1],
                 dtype=np.int32,
             )
@@ -98,15 +87,8 @@ def _column_batch_to_reprs(
             out["codes"] = np.ascontiguousarray(
                 lut[indices.astype(np.int64)]
             )
-        elif repr_name == "lengths":
-            lengths = pc.fill_null(
-                pc.utf8_length(column), pa.scalar(0, pa.int32())
-            )
-            out["lengths"] = np.ascontiguousarray(
-                lengths.to_numpy(zero_copy_only=False).astype(np.int32)
-            )
         else:
-            raise ValueError(f"unknown column repr: {repr_name!r}")
+            out[repr_name] = convert_basic_repr(column, kind, repr_name)
     return out
 
 
@@ -156,6 +138,14 @@ class ParquetDataset(Dataset):
     def select(self, columns: Sequence[str]) -> Dataset:
         return Dataset(self._source.to_table(columns=list(columns)))
 
+    def record_batches(
+        self, columns: Sequence[str], batch_rows: int = 1 << 20
+    ) -> Iterator[pa.RecordBatch]:
+        scanner = self._source.scanner(
+            columns=list(columns), batch_size=batch_rows
+        )
+        return iter(scanner.to_batches())
+
     # -- statistics from parquet metadata -------------------------------
 
     def _column_null_count(self, column: str) -> int:
@@ -180,38 +170,48 @@ class ParquetDataset(Dataset):
     def _is_all_valid(self, column: str) -> bool:
         return self._column_null_count(column) == 0
 
-    def _request_row_bytes(self, r: ColumnRequest) -> int:
-        if r.repr == "mask":
-            return 0 if self._synthesize_mask(r) else 1
-        if r.repr in ("codes", "lengths"):
-            return 4
-        kind = self._schema.kind_of(r.column)
-        if kind in (Kind.BOOLEAN, Kind.STRING):
-            return 4
-        if kind == Kind.TIMESTAMP:
-            return 8
-        try:
-            idx = self._source.schema.get_field_index(r.column)
-            width = max(1, self._source.schema.types[idx].bit_width // 8)
-        except (ValueError, AttributeError):
-            return 8
-        return max(width, 4)
+    def _column_arrow_type(self, column: str) -> pa.DataType:
+        idx = self._source.schema.get_field_index(column)
+        return self._source.schema.types[idx]
 
     # -- global dictionaries (streaming pre-pass) -----------------------
 
+    def _collect_uniques(self, column: str, cap: Optional[int]) -> Optional[Dict]:
+        """Stream distinct values (canonical-keyed); None once > cap."""
+        uniques: Dict = {}
+        scanner = self._source.scanner(
+            columns=[column], batch_size=self._read_batch_rows
+        )
+        for batch in scanner.to_batches():
+            for v in pc.unique(batch.column(0)).to_pylist():
+                if v is not None:
+                    uniques.setdefault(_canon_key(v), v)
+            if cap is not None and len(uniques) > cap:
+                return None
+        return uniques
+
+    def dictionary_size_within(self, column: str, cap: int):
+        if column in self._dictionaries:
+            n = len(self._dictionaries[column])
+            return n if n <= cap else None
+        uniques = self._collect_uniques(column, cap)
+        if uniques is None:
+            return None  # over cap: never materialize the full set
+        self._store_dictionary(column, uniques)
+        return len(self._dictionaries[column])
+
+    def _store_dictionary(self, column: str, uniques: Dict) -> None:
+        ordered = sorted(uniques.values(), key=str)
+        self._dictionaries[column] = np.asarray(ordered, dtype=object)
+        self._code_maps[column] = {
+            _canon_key(v): i for i, v in enumerate(ordered)
+        }
+
     def dictionary(self, column: str) -> np.ndarray:
         if column not in self._dictionaries:
-            uniques = set()
-            scanner = self._source.scanner(
-                columns=[column], batch_size=self._read_batch_rows
+            self._store_dictionary(
+                column, self._collect_uniques(column, None)
             )
-            for batch in scanner.to_batches():
-                for v in pc.unique(batch.column(0)).to_pylist():
-                    if v is not None:
-                        uniques.add(v)
-            ordered = sorted(uniques, key=str)
-            self._dictionaries[column] = np.asarray(ordered, dtype=object)
-            self._code_maps[column] = {v: i for i, v in enumerate(ordered)}
         return self._dictionaries[column]
 
     def _code_map(self, column: str) -> Dict:

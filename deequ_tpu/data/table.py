@@ -47,6 +47,57 @@ def _synthesized_row_mask(nb: int, batch_size: int, n: int):
     return jax.jit(build)()
 
 
+def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
+    """The ONE host->device conversion rule set for mask/values/lengths
+    (codes need a dictionary and stay with their owner). Shared by the
+    in-memory and parquet paths so fill/widening semantics cannot drift."""
+    if repr_name == "mask":
+        if col.null_count == 0:
+            out = np.ones(len(col), dtype=bool)
+        else:
+            is_null = col.is_null()
+            if isinstance(is_null, pa.ChunkedArray):
+                is_null = is_null.combine_chunks()
+            out = ~is_null.to_numpy(zero_copy_only=False)
+        return np.ascontiguousarray(out.astype(bool))
+    if repr_name == "values":
+        if kind == Kind.STRING:
+            raise TypeError(
+                "string columns have no 'values' repr; request 'codes' "
+                "or 'lengths' instead"
+            )
+        filled = col
+        if kind == Kind.TIMESTAMP:
+            filled = pc.cast(col, pa.int64())
+            if col.null_count:
+                filled = pc.fill_null(filled, pa.scalar(0, pa.int64()))
+        elif col.null_count:
+            zero = (
+                pa.scalar(False)
+                if kind == Kind.BOOLEAN
+                else pa.scalar(0, type=col.type)
+            )
+            filled = pc.fill_null(col, zero)
+        if isinstance(filled, pa.ChunkedArray):
+            filled = filled.combine_chunks()
+        out = filled.to_numpy(zero_copy_only=False)
+        if kind == Kind.BOOLEAN:
+            out = out.astype(np.int32)
+        elif out.dtype == np.float16:
+            out = out.astype(np.float32)
+        elif out.dtype.kind not in "iuf":
+            out = out.astype(np.float64)
+        return np.ascontiguousarray(out)
+    if repr_name == "lengths":
+        lengths = pc.fill_null(pc.utf8_length(col), pa.scalar(0, pa.int32()))
+        if isinstance(lengths, pa.ChunkedArray):
+            lengths = lengths.combine_chunks()
+        return np.ascontiguousarray(
+            lengths.to_numpy(zero_copy_only=False).astype(np.int32)
+        )
+    raise ValueError(f"unknown column repr: {repr_name!r}")
+
+
 class Kind(enum.Enum):
     """Logical column kinds (maps Arrow types to analyzer preconditions)."""
 
@@ -218,6 +269,15 @@ class Dataset:
     def select(self, columns: Sequence[str]) -> "Dataset":
         return Dataset(self._table.select(list(columns)))
 
+    def record_batches(
+        self, columns: Sequence[str], batch_rows: int = 1 << 20
+    ) -> "Iterator[pa.RecordBatch]":
+        """Column-pruned record batches (streamed from storage by
+        parquet-backed datasets; zero-copy slices here)."""
+        return iter(
+            self._table.select(list(columns)).to_batches(batch_rows)
+        )
+
     # -- dictionaries ---------------------------------------------------
 
     def dictionary(self, column: str) -> np.ndarray:
@@ -243,9 +303,18 @@ class Dataset:
         )
         self._materialized[f"{column}::codes"] = np.ascontiguousarray(codes)
         dictionary = dict_arr.dictionary
-        self._dictionaries[column] = np.asarray(
-            dictionary.to_pylist(), dtype=object
-        )
+        if pa.types.is_string(dictionary.type) or pa.types.is_large_string(
+            dictionary.type
+        ):
+            self._dictionaries[column] = np.asarray(
+                dictionary.to_pylist(), dtype=object
+            )
+        else:
+            # numeric dictionaries stay native — a to_pylist object
+            # array costs seconds at 10M distinct values
+            self._dictionaries[column] = dictionary.to_numpy(
+                zero_copy_only=False
+            )
 
     # -- device materialization ----------------------------------------
 
@@ -253,54 +322,12 @@ class Dataset:
         key = req.key
         if key in self._materialized:
             return self._materialized[key]
-        col = self._table.column(req.column)
-        kind = self._schema.kind_of(req.column)
-        if req.repr == "mask":
-            if col.null_count == 0:
-                out = np.ones(len(col), dtype=bool)
-            else:
-                out = ~col.is_null().combine_chunks().to_numpy(
-                    zero_copy_only=False
-                )
-            out = np.ascontiguousarray(out.astype(bool))
-        elif req.repr == "values":
-            if kind == Kind.STRING:
-                raise TypeError(
-                    f"column '{req.column}' is a string column; request "
-                    "'codes' or 'lengths' instead of 'values'"
-                )
-            filled = col
-            if kind == Kind.TIMESTAMP:
-                filled = pc.cast(col, pa.int64())
-                if col.null_count:
-                    filled = pc.fill_null(filled, pa.scalar(0, pa.int64()))
-            elif col.null_count:
-                zero = pa.scalar(False) if kind == Kind.BOOLEAN else pa.scalar(
-                    0, type=col.type
-                )
-                filled = pc.fill_null(col, zero)
-            out = filled.combine_chunks().to_numpy(zero_copy_only=False)
-            if kind == Kind.BOOLEAN:
-                out = out.astype(np.int32)
-            elif out.dtype == np.float16:
-                out = out.astype(np.float32)
-            elif out.dtype.kind not in "iuf":
-                out = out.astype(np.float64)
-            out = np.ascontiguousarray(out)
-        elif req.repr == "codes":
+        if req.repr == "codes":
             self._materialize_codes(req.column)
             return self._materialized[key]
-        elif req.repr == "lengths":
-            lengths = pc.fill_null(
-                pc.utf8_length(col), pa.scalar(0, pa.int32())
-            )
-            out = np.ascontiguousarray(
-                lengths.combine_chunks()
-                .to_numpy(zero_copy_only=False)
-                .astype(np.int32)
-            )
-        else:
-            raise ValueError(f"unknown column repr: {req.repr!r}")
+        col = self._table.column(req.column)
+        kind = self._schema.kind_of(req.column)
+        out = convert_basic_repr(col, kind, req.repr)
         self._materialized[key] = out
         return out
 
@@ -381,6 +408,10 @@ class Dataset:
 
         return config.options().synthesize_all_true_masks
 
+    def _column_arrow_type(self, column: str) -> pa.DataType:
+        """Storage-type hook (parquet sources answer from file schema)."""
+        return self._table.column(column).type
+
     def _request_row_bytes(self, r: ColumnRequest) -> int:
         """Device bytes per row for one request (0 for synthesized);
         mirrors what materialize() actually produces, not the Arrow
@@ -395,10 +426,20 @@ class Dataset:
         if kind == Kind.TIMESTAMP:
             return 8
         try:
-            width = max(1, self._table.column(r.column).type.bit_width // 8)
+            width = max(1, self._column_arrow_type(r.column).bit_width // 8)
         except (ValueError, AttributeError):
             return 8
         return max(width, 4)  # f16 materializes as f32
+
+    def dictionary_size_within(
+        self, column: str, cap: int
+    ) -> Optional[int]:
+        """Distinct-value count if it is <= cap, else None WITHOUT
+        necessarily building the full dictionary (parquet sources bail
+        out of the streaming pre-pass once the cap is passed, so a
+        spilling plan never materializes an unbounded value set)."""
+        d = self.dictionary(column)
+        return len(d) if len(d) <= cap else None
 
     def estimated_device_bytes(
         self, requests: Sequence[ColumnRequest], batch_size: int

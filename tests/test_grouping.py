@@ -5,7 +5,9 @@ import math
 
 import pytest
 
+from deequ_tpu import Dataset
 from deequ_tpu.analyzers import (
+    AnalysisRunner,
     CountDistinct,
     Distinctness,
     Entropy,
@@ -14,6 +16,7 @@ from deequ_tpu.analyzers import (
     Uniqueness,
     UniqueValueRatio,
 )
+from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
 from fixtures import df_full, df_missing, df_unique
 
 
@@ -118,3 +121,62 @@ class TestHistogram:
 
         dist = value(Histogram("att2").calculate(df_numeric()))
         assert dist["0"].absolute == 3
+
+
+class TestHighCardinalityPaths:
+    """The dense device path (budget-raised cap, i32 counts) and the
+    streaming Arrow fallback must agree exactly, and merges of large
+    sparse states stay vectorized."""
+
+    @staticmethod
+    def _ds(n=50_000, distinct=30_000, seed=3):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        return Dataset.from_pydict(
+            {"id": rng.integers(0, distinct, n), "pair": rng.integers(0, 50, n)}
+        )
+
+    def test_dense_equals_fallback(self):
+        from deequ_tpu import config
+
+        ds_dense = self._ds()
+        ds_spill = self._ds()
+        analyzers = lambda: [
+            Uniqueness("id"),
+            Distinctness("id"),
+            CountDistinct("id"),
+            Entropy("id"),
+        ]
+        with config.configure(dense_grouping_budget_bytes=1 << 30):
+            dense_ctx = AnalysisRunner.do_analysis_run(ds_dense, analyzers())
+        # a tiny budget (honored exactly) forces the Arrow fallback
+        with config.configure(dense_grouping_budget_bytes=8):
+            spill_ctx = AnalysisRunner.do_analysis_run(
+                ds_spill, analyzers()
+            )
+        for a in analyzers():
+            d = dense_ctx.metric(a).value.get()
+            s = spill_ctx.metric(a).value.get()
+            assert d == pytest.approx(s, rel=1e-12), a
+
+    def test_large_sparse_merge_vectorized(self):
+        import numpy as np
+
+        k = 200_000
+        keys = np.empty((k, 1), dtype=object)
+        keys[:, 0] = np.arange(k)
+        a = FrequenciesAndNumRows(("c",), keys, np.ones(k, dtype=np.int64), k)
+        keys2 = np.empty((k, 1), dtype=object)
+        keys2[:, 0] = np.arange(k // 2, k + k // 2)
+        b = FrequenciesAndNumRows(
+            ("c",), keys2, np.ones(k, dtype=np.int64), k
+        )
+        import time
+
+        t0 = time.time()
+        merged = FrequenciesAndNumRows.merge(a, b)
+        assert time.time() - t0 < 5.0  # dict-loop took tens of seconds
+        assert merged.num_groups == k + k // 2
+        assert merged.counts.sum() == 2 * k
+        assert merged.num_rows == 2 * k
