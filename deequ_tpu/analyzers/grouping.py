@@ -148,29 +148,22 @@ class FrequenciesAndNumRows:
                 np.zeros(0, dtype=np.int64),
                 a.num_rows + b.num_rows,
             )
+        count_col = _free_column_name(columns)
         data = {}
         for j, c in enumerate(columns):
             data[c] = pa.array(
                 np.concatenate([a.keys[:, j], b.keys[:, j]]).tolist()
             )
-        data["__count__"] = pa.array(
+        data[count_col] = pa.array(
             np.concatenate([a.counts, b.counts]), pa.int64()
         )
         grouped = (
-            pa.table(data).group_by(columns).aggregate([("__count__", "sum")])
+            pa.table(data).group_by(columns).aggregate([(count_col, "sum")])
         )
-        counts = grouped.column("__count___sum").to_numpy(
-            zero_copy_only=False
-        )
-        key_arr = np.empty((len(counts), len(columns)), dtype=object)
-        for j, c in enumerate(columns):
-            key_arr[:, j] = np.asarray(
-                grouped.column(c).to_pylist(), dtype=object
-            )
-        return FrequenciesAndNumRows(
-            a.columns,
-            key_arr,
-            counts.astype(np.int64),
+        return _grouped_to_frequencies(
+            grouped,
+            columns,
+            f"{count_col}_sum",
             a.num_rows + b.num_rows,
         )
 
@@ -216,9 +209,12 @@ def compute_many_frequencies(
     remaining = cap
     for plan in plans:
         # capped distinct counts first: a spilling plan must never
-        # materialize an unbounded value set on the host
+        # materialize an unbounded value set on the host (probe with the
+        # REMAINING budget — a plan that cannot fit anyway must not
+        # stream up to the full cap into a host dict first)
         sizes_maybe = [
-            dataset.dictionary_size_within(c, cap) for c in plan.columns
+            dataset.dictionary_size_within(c, remaining)
+            for c in plan.columns
         ]
         joint = 1
         for s in sizes_maybe:
@@ -381,16 +377,35 @@ def _device_frequencies_shared(
     return out
 
 
-def _frequencies_of_table(
-    columns: List[str], table: pa.Table
+def _free_column_name(columns: List[str], base: str = "__count__") -> str:
+    name = base
+    while name in columns:
+        name += "_"
+    return name
+
+
+def _grouped_to_frequencies(
+    grouped: pa.Table,
+    columns: List[str],
+    count_col: str,
+    num_rows: int,
 ) -> FrequenciesAndNumRows:
-    grouped = table.group_by(columns).aggregate([([], "count_all")])
-    counts = grouped.column("count_all").to_numpy(zero_copy_only=False)
+    """Arrow group_by output -> FrequenciesAndNumRows (the one decode)."""
+    counts = grouped.column(count_col).to_numpy(zero_copy_only=False)
     key_arr = np.empty((len(counts), len(columns)), dtype=object)
     for j, c in enumerate(columns):
         key_arr[:, j] = np.asarray(grouped.column(c).to_pylist(), dtype=object)
     return FrequenciesAndNumRows(
-        tuple(columns), key_arr, counts.astype(np.int64), int(table.num_rows)
+        tuple(columns), key_arr, counts.astype(np.int64), num_rows
+    )
+
+
+def _frequencies_of_table(
+    columns: List[str], table: pa.Table
+) -> FrequenciesAndNumRows:
+    grouped = table.group_by(columns).aggregate([([], "count_all")])
+    return _grouped_to_frequencies(
+        grouped, columns, "count_all", int(table.num_rows)
     )
 
 
@@ -435,16 +450,8 @@ def _arrow_frequencies(
         grouped = combined.group_by(columns).aggregate(
             [("count_all", "sum")]
         )
-        counts = grouped.column("count_all_sum").to_numpy(
-            zero_copy_only=False
-        )
-        key_arr = np.empty((len(counts), len(columns)), dtype=object)
-        for j, c in enumerate(columns):
-            key_arr[:, j] = np.asarray(
-                grouped.column(c).to_pylist(), dtype=object
-            )
-        return FrequenciesAndNumRows(
-            tuple(columns), key_arr, counts.astype(np.int64), num_rows
+        return _grouped_to_frequencies(
+            grouped, columns, "count_all_sum", num_rows
         )
     # where-filter: the predicate needs full device reprs — materialize
     table = dataset.table.select(columns)
