@@ -72,9 +72,12 @@ def bench_profiler(num_rows: int, num_cols: int):
 
     fresh = _tpcds_like(num_rows, num_cols, seed=2)
     t0 = time.time()
-    ColumnProfiler.profile(fresh)
+    profiles = ColumnProfiler.profile(fresh)
     wall = time.time() - t0
-    return {"wall_s": wall, "cold_s": warm_s, "rows_per_sec": num_rows / wall}
+    out = {"wall_s": wall, "cold_s": warm_s, "rows_per_sec": num_rows / wall}
+    if profiles.run_metadata is not None:
+        out["passes"] = profiles.run_metadata.as_records()
+    return out
 
 
 def bench_fused_bundle(num_rows: int):

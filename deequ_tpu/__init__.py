@@ -119,6 +119,10 @@ from deequ_tpu.schema import (  # noqa: E402
     RowLevelSchemaValidator,
 )
 from deequ_tpu.sketches.kll import KLLParameters  # noqa: E402
+from deequ_tpu.utils.observe import (  # noqa: E402
+    RunMetadata,
+    profiler_trace,
+)
 
 __version__ = "0.2.0"
 
@@ -182,6 +186,8 @@ __all__ = [
     "ResultKey",
     "RowLevelSchema",
     "RowLevelSchemaValidator",
+    "RunMetadata",
+    "profiler_trace",
     "SeriesSeasonality",
     "SimpleThresholdStrategy",
     "Size",

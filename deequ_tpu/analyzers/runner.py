@@ -26,6 +26,7 @@ from deequ_tpu.analyzers.base import (
 from deequ_tpu.data.table import Dataset, Schema
 from deequ_tpu.engine.scan import AnalysisEngine
 from deequ_tpu.metrics.metric import Metric
+from deequ_tpu.utils.observe import RunMetadata, timed_pass
 
 
 # --------------------------------------------------------------------------
@@ -35,9 +36,12 @@ from deequ_tpu.metrics.metric import Metric
 
 @dataclass
 class AnalyzerContext:
-    """Map analyzer -> metric (reference: AnalyzerContext.scala)."""
+    """Map analyzer -> metric (reference: AnalyzerContext.scala), plus
+    per-pass wall-time metadata (deequ_tpu.utils.observe — beyond the
+    reference, SURVEY.md §5.1)."""
 
     metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
+    run_metadata: Optional["RunMetadata"] = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":
@@ -52,7 +56,12 @@ class AnalyzerContext:
     def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
         merged = dict(self.metric_map)
         merged.update(other.metric_map)
-        return AnalyzerContext(merged)
+        return AnalyzerContext(
+            merged,
+            run_metadata=RunMetadata.merge_optional(
+                self.run_metadata, other.run_metadata
+            ),
+        )
 
     def success_metrics_as_records(
         self, for_analyzers: Optional[Sequence[Analyzer]] = None
@@ -173,23 +182,30 @@ class AnalysisRunner:
         ]
 
         metrics: Dict[Analyzer, Metric] = dict(failures)
+        metadata = RunMetadata()
+        rows = data.num_rows
 
         # 4) ONE fused scan for every scan-shareable analyzer
-        metrics.update(
-            _run_scanning_analyzers(
-                data, scan_shareable, engine, aggregate_with, save_states_with
-            )
-        )
+        if scan_shareable:
+            with timed_pass(metadata, "scan", rows, len(scan_shareable)):
+                metrics.update(
+                    _run_scanning_analyzers(
+                        data, scan_shareable, engine, aggregate_with,
+                        save_states_with,
+                    )
+                )
 
         # 5) one frequency computation per (grouping columns, filter)
         if grouping:
             from deequ_tpu.analyzers.grouping import run_grouping_analyzers
 
-            metrics.update(
-                run_grouping_analyzers(
-                    data, grouping, engine, aggregate_with, save_states_with
+            with timed_pass(metadata, "grouping", rows, len(grouping)):
+                metrics.update(
+                    run_grouping_analyzers(
+                        data, grouping, engine, aggregate_with,
+                        save_states_with,
+                    )
                 )
-            )
 
         # 6) schema-only analyzers
         for analyzer in others:
@@ -198,7 +214,7 @@ class AnalysisRunner:
             except Exception as exc:  # noqa: BLE001
                 metrics[analyzer] = analyzer.to_failure_metric(exc)
 
-        context = reused + AnalyzerContext(metrics)
+        context = reused + AnalyzerContext(metrics, run_metadata=metadata)
 
         # 7) optionally persist to the metrics repository
         if metrics_repository is not None and save_or_append_results_with_key is not None:

@@ -77,6 +77,7 @@ class NumericColumnProfile(StandardColumnProfile):
 class ColumnProfiles:
     profiles: Dict[str, StandardColumnProfile]
     num_records: int
+    run_metadata: Optional["object"] = None  # utils.observe.RunMetadata
 
     def __getitem__(self, column: str) -> StandardColumnProfile:
         return self.profiles[column]
@@ -241,7 +242,12 @@ class ColumnProfiler:
                 )
             else:
                 profiles[c] = StandardColumnProfile(**base)
-        return ColumnProfiles(profiles, num_records)
+        from deequ_tpu.utils.observe import RunMetadata
+
+        metadata = ctx1.run_metadata
+        for ctx in (ctx2, ctx3):
+            metadata = RunMetadata.merge_optional(metadata, ctx.run_metadata)
+        return ColumnProfiles(profiles, num_records, run_metadata=metadata)
 
 
 def _cast_string_columns(data: Dataset, columns: Sequence[str]) -> Dataset:

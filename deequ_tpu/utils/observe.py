@@ -1,0 +1,106 @@
+"""Observability: per-pass wall-time metadata + jax.profiler hooks.
+
+The reference has NO in-repo execution tracing — observability is
+delegated to the Spark UI (SURVEY.md §5.1 calls this "a gap we can
+exceed"). Here every analysis run records a :class:`PassTiming` per
+engine pass (fused scan, frequency pass, direct analyzers), attached to
+the AnalyzerContext / VerificationResult, and :func:`profiler_trace`
+wraps a block in a jax.profiler trace whose dump opens in
+TensorBoard/XProf for kernel-level timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+
+@dataclass
+class PassTiming:
+    name: str  # "scan" | "grouping" | "direct" | custom
+    wall_s: float
+    rows: int
+    num_analyzers: int
+
+    @property
+    def rows_per_sec(self) -> float:
+        return self.rows / self.wall_s if self.wall_s > 0 else 0.0
+
+
+@dataclass
+class RunMetadata:
+    """Timings for one AnalysisRunner run."""
+
+    passes: List[PassTiming] = field(default_factory=list)
+
+    @property
+    def total_wall_s(self) -> float:
+        return sum(p.wall_s for p in self.passes)
+
+    def record(
+        self, name: str, wall_s: float, rows: int, num_analyzers: int
+    ) -> None:
+        self.passes.append(PassTiming(name, wall_s, rows, num_analyzers))
+
+    def merge(self, other: Optional["RunMetadata"]) -> "RunMetadata":
+        """Always a FRESH instance — never alias a mutable passes list
+        between contexts."""
+        if other is None:
+            return RunMetadata(list(self.passes))
+        return RunMetadata(self.passes + other.passes)
+
+    @staticmethod
+    def merge_optional(
+        a: Optional["RunMetadata"], b: Optional["RunMetadata"]
+    ) -> Optional["RunMetadata"]:
+        if a is None and b is None:
+            return None
+        if a is None:
+            return b.merge(None)
+        return a.merge(b)
+
+    def as_records(self) -> List[dict]:
+        return [
+            {
+                "pass": p.name,
+                "wall_s": round(p.wall_s, 6),
+                "rows": p.rows,
+                "num_analyzers": p.num_analyzers,
+                "rows_per_sec": round(p.rows_per_sec, 1),
+            }
+            for p in self.passes
+        ]
+
+
+@contextlib.contextmanager
+def timed_pass(
+    metadata: Optional[RunMetadata],
+    name: str,
+    rows: int,
+    num_analyzers: int,
+) -> Iterator[None]:
+    """Time a pass (and annotate it for an active jax.profiler trace)."""
+    if metadata is None:
+        yield
+        return
+    import jax
+
+    start = time.perf_counter()
+    with jax.profiler.TraceAnnotation(f"deequ_tpu:{name}"):
+        yield
+    metadata.record(name, time.perf_counter() - start, rows, num_analyzers)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace of the wrapped block into
+    ``log_dir`` (open with TensorBoard's profile plugin / XProf)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -42,6 +42,7 @@ class VerificationResult:
         self.check_results = check_results
         self.metrics = metrics
         self._data = data  # for row-level results; None on state-only runs
+        self.run_metadata = None  # per-pass timings (set by the suite)
 
     def row_level_results_as_dataset(
         self, data: Optional[Dataset] = None
@@ -159,9 +160,11 @@ class VerificationSuite:
                 key=lambda s: ["Success", "Warning", "Error"].index(s.value),
             )
             status = worst
-        return VerificationResult(
+        result = VerificationResult(
             status, check_results, context.metric_map, data=data
         )
+        result.run_metadata = context.run_metadata
+        return result
 
 
 class VerificationRunBuilder:

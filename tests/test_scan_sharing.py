@@ -51,3 +51,65 @@ def test_batched_equals_single_batch():
         a = ctx_one.metric(analyzer).value.get()
         b = ctx_many.metric(analyzer).value.get()
         assert abs(a - b) < 1e-8 * max(1.0, abs(a)), analyzer
+
+
+class TestRunMetadata:
+    """Per-pass wall-time metadata (SURVEY.md §5.1: an observability
+    hook the reference lacks)."""
+
+    def test_runner_records_passes(self):
+        import numpy as np
+
+        from deequ_tpu import Dataset, Completeness, Mean, Uniqueness
+        from deequ_tpu.analyzers import AnalysisRunner
+
+        ds = Dataset.from_pydict({"x": list(np.arange(1000.0))})
+        ctx = AnalysisRunner.do_analysis_run(
+            ds, [Completeness("x"), Mean("x"), Uniqueness("x")]
+        )
+        meta = ctx.run_metadata
+        assert meta is not None
+        names = [p.name for p in meta.passes]
+        assert names == ["scan", "grouping"]
+        for p in meta.passes:
+            assert p.wall_s > 0 and p.rows == 1000
+        assert meta.passes[0].num_analyzers == 2
+        assert meta.total_wall_s > 0
+        assert meta.as_records()[0]["pass"] == "scan"
+
+    def test_verification_result_carries_metadata(self):
+        import numpy as np
+
+        from deequ_tpu import (
+            Check,
+            CheckLevel,
+            Dataset,
+            VerificationSuite,
+        )
+
+        ds = Dataset.from_pydict({"x": list(np.arange(100.0))})
+        result = (
+            VerificationSuite()
+            .on_data(ds)
+            .add_check(
+                Check(CheckLevel.ERROR, "m").has_mean("x", lambda m: m > 0)
+            )
+            .run()
+        )
+        assert result.run_metadata is not None
+        assert result.run_metadata.passes
+
+    def test_profiler_aggregates_pass_timings(self):
+        import numpy as np
+
+        from deequ_tpu import Dataset
+        from deequ_tpu.profiles.profiler import ColumnProfiler
+
+        ds = Dataset.from_pydict(
+            {"x": list(np.arange(500.0)), "c": ["a", "b"] * 250}
+        )
+        profiles = ColumnProfiler.profile(ds)
+        meta = profiles.run_metadata
+        assert meta is not None
+        # pass 1 (scan incl. DataType) + pass 2 (numeric) + pass 3 (hist)
+        assert len(meta.passes) >= 3
