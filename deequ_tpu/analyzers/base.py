@@ -158,8 +158,16 @@ Batch = Dict[str, Any]
 @dataclass
 class ScanOps:
     """The (identity, update, merge) triple for one analyzer, compiled
-    against a concrete dataset (closures hold dictionaries / compiled
-    predicates).
+    against a concrete dataset (closures hold compiled predicates).
+
+    ``consts`` — per-dataset lookup tables (dictionary LUTs for
+    PatternMatch/DataType/HLL-on-strings). They enter the jitted scan as
+    RUNTIME INPUTS, not closure constants: embedded constants would bake
+    each dataset's dictionary into the HLO and force a full XLA
+    recompile per dataset, defeating the persistent compilation cache.
+    When ``consts`` is set, ``update`` takes ``(state, batch, consts)``.
+    LUT shapes should be padded to powers of two (``pad_pow2``) so
+    different dictionaries of similar size share one compiled program.
 
     Host-folded analyzers (KLL): ``update`` emits a small fixed-shape
     per-batch device output instead of a running carry, and the engine
@@ -167,10 +175,26 @@ class ScanOps:
     — only k floats cross the boundary, the data pass stays fused."""
 
     init: Callable[[], StateTree]
-    update: Callable[[StateTree, Batch], StateTree]
+    update: Callable[..., StateTree]
     merge: Callable[[StateTree, StateTree], StateTree]
     host_init: Optional[Callable[[], Any]] = None
     host_fold: Optional[Callable[[Any, Any], Any]] = None
+    consts: Optional[Dict[str, np.ndarray]] = None
+
+    def apply_update(self, state, batch, consts):
+        if self.consts is None:
+            return self.update(state, batch)
+        return self.update(state, batch, consts)
+
+
+def pad_pow2(arr: np.ndarray, fill=0) -> np.ndarray:
+    """Pad a 1-D LUT to the next power-of-two length so compiled scans
+    are shared across datasets whose dictionaries have similar sizes."""
+    n = len(arr)
+    m = 1 << max(0, (n - 1).bit_length())
+    if m <= n:
+        return arr
+    return np.concatenate([arr, np.full(m - n, fill, dtype=arr.dtype)])
 
 
 # --------------------------------------------------------------------------

@@ -287,6 +287,8 @@ class PatternMatch(ScanShareableAnalyzer):
         ] + reqs
 
     def make_ops(self, dataset: Dataset) -> ScanOps:
+        from deequ_tpu.analyzers.base import pad_pow2
+
         where_fn, _ = _compile_where(self.where, dataset)
         col = self.column
         dictionary = dataset.dictionary(col)
@@ -295,9 +297,13 @@ class PatternMatch(ScanShareableAnalyzer):
         for i, value in enumerate(dictionary):
             if value is not None and prog.search(str(value)):
                 table[i] = True
-        lut = jnp.asarray(table)
 
-        def update(state: S.NumMatchesAndCount, batch) -> S.NumMatchesAndCount:
+        # LUT enters the scan as a runtime input (pow2-padded), so the
+        # compiled program is shared across datasets — see ScanOps.consts
+        def update(
+            state: S.NumMatchesAndCount, batch, consts
+        ) -> S.NumMatchesAndCount:
+            lut = consts["lut"]
             rows = _row_mask(batch, where_fn)
             codes = batch[f"{col}::codes"]
             valid = batch[f"{col}::mask"] & rows
@@ -308,7 +314,10 @@ class PatternMatch(ScanShareableAnalyzer):
             )
 
         return ScanOps(
-            S.NumMatchesAndCount.identity, update, S.NumMatchesAndCount.merge
+            S.NumMatchesAndCount.identity,
+            update,
+            S.NumMatchesAndCount.merge,
+            consts={"lut": pad_pow2(table, False)},
         )
 
     def compute_metric_from_state(self, state) -> DoubleMetric:

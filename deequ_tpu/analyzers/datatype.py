@@ -85,6 +85,8 @@ class DataType(ScanShareableAnalyzer):
         kind = dataset.schema.kind_of(col)
 
         if kind == Kind.STRING:
+            from deequ_tpu.analyzers.base import pad_pow2
+
             dictionary = dataset.dictionary(col)
             lut = np.zeros(max(len(dictionary), 1), dtype=np.int32)
             for i, value in enumerate(dictionary):
@@ -93,13 +95,17 @@ class DataType(ScanShareableAnalyzer):
                     if value is None
                     else classify_string(str(value))
                 )
-            lut_dev = jnp.asarray(lut)
 
-            def update(state: DataTypeHistogram, batch) -> DataTypeHistogram:
+            # LUT as runtime input (pow2-padded): shared compiled scan
+            # across datasets — see ScanOps.consts
+            def update(
+                state: DataTypeHistogram, batch, consts
+            ) -> DataTypeHistogram:
+                table = consts["lut"]
                 rows = _row_mask(batch, where_fn)
                 valid = batch[f"{col}::mask"] & rows
                 codes = batch[f"{col}::codes"]
-                bucket = lut_dev[jnp.clip(codes, 0, lut_dev.shape[0] - 1)]
+                bucket = table[jnp.clip(codes, 0, table.shape[0] - 1)]
                 bucket = jnp.where(valid, bucket, DataTypeHistogram.NULL)
                 bucket = jnp.where(rows, bucket, 5)  # padding -> reserved
                 counts = jnp.bincount(bucket, length=7)[:6]
@@ -107,6 +113,12 @@ class DataType(ScanShareableAnalyzer):
                 new = new.at[5].set(0)
                 return DataTypeHistogram(new)
 
+            return ScanOps(
+                DataTypeHistogram.identity,
+                update,
+                DataTypeHistogram.merge,
+                consts={"lut": pad_pow2(lut, DataTypeHistogram.STRING)},
+            )
         else:
             static_bucket = {
                 Kind.INTEGRAL: DataTypeHistogram.INTEGRAL,

@@ -49,6 +49,12 @@ NULL_VALUE = "NullValue"  # reference: Histogram's bin name for nulls
 MAX_DENSE_JOINT = 1 << 24  # dense cap floor when no budget is configured
 
 
+def _padded_dense_len(joint: int) -> int:
+    """Pow2 length of the dense count vector: 1 << bit_length(joint) is
+    strictly greater than joint, so the overflow slot always fits."""
+    return 1 << max(1, int(joint).bit_length())
+
+
 def _dense_joint_cap(num_rows: int) -> Tuple[int, "np.dtype"]:
     """(max COMBINED joint key space, count dtype) for the dense device
     path. The cap follows the configured grouping budget exactly (a
@@ -222,11 +228,14 @@ def compute_many_frequencies(
                 joint = None
                 break
             joint *= s + 1  # +1: the null slot
-        if joint is not None and joint <= remaining:
+        # debit what _make_dense_ops ACTUALLY allocates (the pow2-padded
+        # vector), or plans sized right at the budget would exceed it
+        padded = _padded_dense_len(joint) if joint is not None else None
+        if padded is not None and padded <= remaining:
             dictionaries = [dataset.dictionary(c) for c in plan.columns]
             sizes = [len(d) + 1 for d in dictionaries]
             dense.append((plan, dictionaries, sizes))
-            remaining -= joint
+            remaining -= padded
         else:
             results[plan] = _arrow_frequencies(dataset, plan)
     if dense:
@@ -274,14 +283,20 @@ def _make_dense_ops(
     jnp_count = jnp.int32 if count_dtype == np.int32 else jnp.int64
     # joint codes need int64 lanes once the key space passes 2^31
     code_dtype = jnp.int64 if joint >= 2**31 else jnp.int32
+    # count vector padded to pow2 (always > joint, so the overflow slot
+    # fits): the compiled scan is then shared across datasets whose key
+    # spaces round to the same size, and the per-column SIZES enter as
+    # runtime consts rather than baked-in scalars — see ScanOps.consts
+    padded_len = _padded_dense_len(joint)
 
     def init():
         return (
-            np.zeros(joint, dtype=count_dtype),
+            np.zeros(padded_len, dtype=count_dtype),
             np.int64(0),
         )
 
-    def update(state, batch):
+    def update(state, batch, consts):
+        sizes_arr = consts["sizes"]
         counts, num_rows = state
         rows = batch[ROW_MASK]
         if where_fn is not None:
@@ -296,17 +311,22 @@ def _make_dense_ops(
         code = jnp.zeros(
             batch[f"{columns[0]}::codes"].shape, dtype=code_dtype
         )
-        for c, size in zip(columns, sizes):
+        for j, c in enumerate(columns):
             shifted = (batch[f"{c}::codes"] + 1).astype(code_dtype)
-            code = code * size + shifted  # null (-1) -> slot 0
-        # masked scatter-add; rejected rows go to an overflow slot
-        code = jnp.where(keep, code, joint)
+            code = code * sizes_arr[j] + shifted  # null (-1) -> slot 0
+        # masked scatter-add; rejected rows go to the overflow slot
+        code = jnp.where(keep, code, padded_len - 1)
         counts = counts + jnp.bincount(
-            code, length=joint + 1
-        )[:joint].astype(jnp_count)
+            code, length=padded_len
+        ).astype(jnp_count)
         return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
 
-    ops = ScanOps(init, update, lambda a, b: (a[0] + b[0], a[1] + b[1]))
+    ops = ScanOps(
+        init,
+        update,
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        consts={"sizes": np.asarray(sizes, dtype=code_dtype)},
+    )
     return requests, ops
 
 
@@ -371,8 +391,15 @@ def _device_frequencies_shared(
     states = engine.run_scan(dataset, planned)  # type: ignore[arg-type]
     out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
     for (plan, dictionaries, sizes), (counts, num_rows) in zip(dense, states):
+        joint = 1
+        for s in sizes:
+            joint *= s
         out[plan] = _decode_dense(
-            plan, dictionaries, sizes, np.asarray(counts), int(num_rows)
+            plan,
+            dictionaries,
+            sizes,
+            np.asarray(counts)[:joint],  # drop pow2 padding + overflow
+            int(num_rows),
         )
     return out
 

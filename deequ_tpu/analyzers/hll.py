@@ -50,37 +50,47 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
         ] + reqs
 
     def make_ops(self, dataset: Dataset) -> ScanOps:
+        from deequ_tpu.analyzers.base import pad_pow2
+
         where_fn, _ = _compile_where(self.where, dataset)
         col = self.column
         kind = dataset.schema.kind_of(col)
 
-        if kind == Kind.STRING:
-            lut1_host, lut2_host = hll.dictionary_hash_pairs(
-                dataset.dictionary(col)
-            )
-            lut1, lut2 = jnp.asarray(lut1_host), jnp.asarray(lut2_host)
-
-            def hashes_of(batch):
-                codes = jnp.clip(batch[f"{col}::codes"], 0, lut1.shape[0] - 1)
-                return lut1[codes], lut2[codes]
-
-        else:
-
-            def hashes_of(batch):
-                return hll.hash_pair_numeric(batch[f"{col}::values"])
-
         def init() -> ApproxCountDistinctState:
             return ApproxCountDistinctState(np.zeros(hll.M, dtype=np.int32))
 
-        def update(state: ApproxCountDistinctState, batch):
+        if kind == Kind.STRING:
+            # hash LUTs as runtime inputs (pow2-padded): the compiled
+            # scan is shared across datasets — see ScanOps.consts
+            lut1_host, lut2_host = hll.dictionary_hash_pairs(
+                dataset.dictionary(col)
+            )
+            consts = {"h1": pad_pow2(lut1_host), "h2": pad_pow2(lut2_host)}
+
+            def hashes_of(batch, c):
+                lut1, lut2 = c["h1"], c["h2"]
+                codes = jnp.clip(
+                    batch[f"{col}::codes"], 0, lut1.shape[0] - 1
+                )
+                return lut1[codes], lut2[codes]
+
+        else:
+            consts = None
+
+            def hashes_of(batch, c):
+                return hll.hash_pair_numeric(batch[f"{col}::values"])
+
+        def update(state: ApproxCountDistinctState, batch, consts_in=None):
             mask = batch[f"{col}::mask"] & _row_mask(batch, where_fn)
-            h1, h2 = hashes_of(batch)
+            h1, h2 = hashes_of(batch, consts_in)
             regs = hll.registers_from_hash_pair(h1, h2, mask)
             return ApproxCountDistinctState(
                 jnp.maximum(state.registers, regs)
             )
 
-        return ScanOps(init, update, ApproxCountDistinctState.merge)
+        return ScanOps(
+            init, update, ApproxCountDistinctState.merge, consts=consts
+        )
 
     def compute_metric_from_state(self, state) -> DoubleMetric:
         if state is None:
