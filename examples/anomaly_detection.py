@@ -1,0 +1,72 @@
+"""Anomaly detection on a metric time series via the repository.
+
+Reference example: anomaly-detection example (SURVEY.md §2.5, §3.5):
+append daily Size metrics to a repository, then let an anomaly check
+compare today's value against the history.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)  # allow running from a source checkout without installing
+
+import numpy as np
+
+from deequ_tpu import (
+    Dataset,
+    InMemoryMetricsRepository,
+    RelativeRateOfChangeStrategy,
+    ResultKey,
+    Size,
+    VerificationSuite,
+)
+
+
+def main():
+    repository = InMemoryMetricsRepository()
+    rng = np.random.default_rng(2)
+
+    def dataset_of(n):
+        return Dataset.from_pydict({"x": rng.normal(0, 1, n)})
+
+    # seed a week of history with ~stable sizes
+    for d, n in enumerate([10_000, 10_200, 9_900, 10_100, 10_050]):
+        (
+            VerificationSuite()
+            .on_data(dataset_of(n))
+            .use_repository(repository)
+            .save_or_append_result(ResultKey.of(d))
+            .add_anomaly_check(
+                RelativeRateOfChangeStrategy(
+                    max_rate_decrease=0.8, max_rate_increase=1.2
+                ),
+                Size(),
+            )
+            .run()
+        )
+
+    # today the pipeline truncated: only 3k rows arrive
+    result = (
+        VerificationSuite()
+        .on_data(dataset_of(3_000))
+        .use_repository(repository)
+        .save_or_append_result(ResultKey.of(5))
+        .add_anomaly_check(
+            RelativeRateOfChangeStrategy(
+                max_rate_decrease=0.8, max_rate_increase=1.2
+            ),
+            Size(),
+        )
+        .run()
+    )
+    print(f"today's run status: {result.status}")
+    for record in result.check_results_as_records():
+        print(f"  {record['constraint']}: {record['constraint_status']} "
+              f"{record['constraint_message']}")
+    assert result.status.value != "Success", "the 70% drop must be flagged"
+
+
+if __name__ == "__main__":
+    main()

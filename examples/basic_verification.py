@@ -1,0 +1,62 @@
+"""Basic verification: declare checks, run one fused scan, read results.
+
+Reference example: the reference's basic-usage example
+(``examples/`` — SURVEY.md §2.5): define a Check with several
+constraints, run the suite, inspect constraint results.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)  # allow running from a source checkout without installing
+
+import numpy as np
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, Dataset, VerificationSuite
+
+
+def main():
+    rng = np.random.default_rng(0)
+    data = Dataset.from_pydict(
+        {
+            "id": np.arange(10_000),
+            "product": rng.choice(["thingA", "thingB", "thingC"], 10_000),
+            "value": rng.normal(100.0, 15.0, 10_000),
+            "priority": rng.choice(["high", "low", None], 10_000, p=[0.3, 0.6, 0.1]),
+        }
+    )
+
+    result = (
+        VerificationSuite()
+        .on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "integrity checks")
+            .has_size(lambda s: s == 10_000)
+            .is_complete("id")
+            .is_unique("id")
+            .is_contained_in("product", ["thingA", "thingB", "thingC"])
+            .is_non_negative("value")
+        )
+        .add_check(
+            Check(CheckLevel.WARNING, "distribution checks")
+            .has_completeness("priority", lambda c: c > 0.8)
+            .has_mean("value", lambda m: 90 < m < 110)
+            .has_standard_deviation("value", lambda s: 10 < s < 20)
+        )
+        .run()
+    )
+
+    print(f"verification status: {result.status}")
+    for record in result.check_results_as_records():
+        print(
+            f"  [{record['check']}] {record['constraint']}: "
+            f"{record['constraint_status']} {record['constraint_message']}"
+        )
+    if result.status != CheckStatus.SUCCESS:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Data-parallel mesh execution: the same analysis sharded over devices.
+
+No reference analog (Spark owns distribution there — SURVEY.md §2.6);
+this is the TPU-native story: shard batches over the ``dp`` axis of a
+``jax.sharding.Mesh`` and let XLA insert the collectives. On a machine
+without multiple accelerators, run with:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/mesh_execution.py
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)  # allow running from a source checkout without installing
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from deequ_tpu import (
+    AnalysisEngine,
+    Check,
+    CheckLevel,
+    Dataset,
+    VerificationSuite,
+)
+
+
+def main():
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("dp",))
+    print(f"mesh: {len(devices)} x {devices[0].platform}")
+
+    rng = np.random.default_rng(4)
+    data = Dataset.from_pydict(
+        {"v": rng.normal(10.0, 2.0, 1_000_000), "k": rng.integers(0, 9, 1_000_000)}
+    )
+    result = (
+        VerificationSuite()
+        .on_data(data)
+        .with_engine(AnalysisEngine(mesh=mesh, batch_size=250_000))
+        .add_check(
+            Check(CheckLevel.ERROR, "sharded")
+            .has_size(lambda s: s == 1_000_000)
+            .has_mean("v", lambda m: 9.9 < m < 10.1)
+            .has_number_of_distinct_values("k", lambda n: n == 9)
+        )
+        .run()
+    )
+    print(f"sharded verification: {result.status}")
+    for rec in (result.run_metadata.as_records() if result.run_metadata else []):
+        print(f"  [pass {rec['pass']}] {rec['wall_s']:.2f}s")
+
+
+if __name__ == "__main__":
+    main()
