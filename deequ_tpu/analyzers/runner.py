@@ -262,10 +262,19 @@ class AnalysisRunner:
                 if not states:
                     metrics[analyzer] = analyzer.compute_metric_from_state(None)
                     continue
+                merge = _merge_fn_for(states[0])
+                # tree fold: O(log N) depth — a left fold over N large
+                # frequency states would re-touch the accumulated keys
+                # N times (SURVEY.md §3.2's merge is associative, so any
+                # shape is valid)
+                while len(states) > 1:
+                    states = [
+                        merge(states[i], states[i + 1])
+                        if i + 1 < len(states)
+                        else states[i]
+                        for i in range(0, len(states), 2)
+                    ]
                 merged = states[0]
-                merge = _merge_fn_for(merged)
-                for s in states[1:]:
-                    merged = merge(merged, s)
                 if save_states_with is not None:
                     save_states_with.persist(analyzer, merged)
                 metrics[analyzer] = analyzer.compute_metric_from_state(merged)

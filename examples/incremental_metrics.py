@@ -28,7 +28,6 @@ from deequ_tpu.analyzers import AnalysisRunner
 
 
 def main():
-    rng = np.random.default_rng(1)
     analyzers = [Size(), Mean("amount"), Completeness("amount"),
                  ApproxCountDistinct("customer")]
 

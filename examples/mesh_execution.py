@@ -32,6 +32,17 @@ from deequ_tpu import (
 
 def main():
     devices = np.array(jax.devices())
+    if len(devices) == 1:
+        # images that pre-import jax consume JAX_PLATFORMS before this
+        # script runs; fall back to the config override (must happen
+        # before the backend is initialized to take effect)
+        print(
+            "NOTE: only one device visible — a single-device mesh "
+            "demonstrates no sharding. Re-run with the env vars from "
+            "the module docstring, or on an image that pre-imports "
+            "jax, set jax.config.update('jax_platforms', 'cpu') plus "
+            "the XLA_FLAGS device-count flag before ANY jax use."
+        )
     mesh = Mesh(devices, ("dp",))
     print(f"mesh: {len(devices)} x {devices[0].platform}")
 

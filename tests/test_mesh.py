@@ -67,3 +67,54 @@ def test_explicit_shard_map_step(cpu_mesh):
         metric = analyzer.compute_metric_from_state(jax.device_get(state))
         expected = ctx.metric(analyzer).value.get()
         assert metric.value.get() == pytest.approx(expected, rel=1e-9)
+
+
+def test_mesh_grouping_equals_single_device(cpu_mesh):
+    """Dense frequency scans under the mesh (NamedSharding batches, XLA
+    collectives) must equal the single-device result."""
+    from deequ_tpu import Dataset
+    from deequ_tpu.analyzers import CountDistinct, Histogram, Uniqueness
+
+    rng = np.random.default_rng(9)
+    data = Dataset.from_pydict(
+        {"g": rng.integers(0, 500, 40_000), "h": rng.choice(["a", "b", "c"], 40_000)}
+    )
+    analyzers = [CountDistinct("g"), Uniqueness("g"), Histogram("h")]
+    single = AnalysisRunner.do_analysis_run(data, analyzers)
+    meshed = AnalysisRunner.do_analysis_run(
+        data, analyzers, engine=AnalysisEngine(mesh=cpu_mesh, batch_size=8_192)
+    )
+    for a in (CountDistinct("g"), Uniqueness("g")):
+        assert single.metric(a).value.get() == pytest.approx(
+            meshed.metric(a).value.get()
+        ), a
+    hs = single.metric(Histogram("h")).value.get()
+    hm = meshed.metric(Histogram("h")).value.get()
+    assert {k: v.absolute for k, v in hs.values.items()} == {
+        k: v.absolute for k, v in hm.values.items()
+    }
+
+
+def test_incremental_tree_merge_many_states(tmp_path):
+    """run_on_aggregated_states over MANY providers (tree fold)."""
+    import os
+
+    from deequ_tpu import Dataset, FileSystemStateProvider
+    from deequ_tpu.analyzers import CountDistinct, Mean, Size
+
+    analyzers = [Size(), Mean("x"), CountDistinct("x")]
+    providers = []
+    total = 0
+    for i in range(9):
+        ds = Dataset.from_pydict(
+            {"x": list(np.arange(i * 10.0, i * 10.0 + 10.0))}
+        )
+        p = FileSystemStateProvider(os.path.join(tmp_path, f"s{i}"))
+        AnalysisRunner.do_analysis_run(ds, analyzers, save_states_with=p)
+        providers.append(p)
+        total += 10
+    schema = Dataset.from_pydict({"x": [1.0]}).schema
+    ctx = AnalysisRunner.run_on_aggregated_states(schema, analyzers, providers)
+    assert ctx.metric(Size()).value.get() == total
+    assert ctx.metric(CountDistinct("x")).value.get() == 90.0
+    assert ctx.metric(Mean("x")).value.get() == pytest.approx(44.5)
