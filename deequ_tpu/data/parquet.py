@@ -37,55 +37,32 @@ from deequ_tpu.data.table import (
     Schema,
     _kind_of,
     convert_basic_repr,
+    dictionary_to_numpy,
 )
-
-
-class _NanKey:
-    """Canonical dict key for float NaN (NaN != NaN, so raw NaN values
-    can never hit a dict entry; Arrow's dictionary_encode treats NaNs
-    as equal, and the in-memory path must agree with the parquet one)."""
-
-
-_NAN_KEY = _NanKey()
-
-
-def _canon_key(value):
-    if isinstance(value, float) and value != value:
-        return _NAN_KEY
-    return value
 
 
 def _column_batch_to_reprs(
     column: pa.Array,
     kind: Kind,
     requests: List[str],
-    code_map: Optional[Dict] = None,
+    value_set: Optional[pa.Array] = None,
 ) -> Dict[str, np.ndarray]:
     """Convert one record-batch column into the requested device reprs.
     mask/values/lengths share Dataset.materialize's conversion rules
-    (table.convert_basic_repr); codes remap the batch-local dictionary
-    through the dataset-global code map."""
+    (table.convert_basic_repr); codes come from a vectorized
+    ``pc.index_in`` against the dataset-global dictionary (Arrow treats
+    NaN as equal to NaN, matching the in-memory dictionary_encode
+    path; nulls index to -1)."""
     out: Dict[str, np.ndarray] = {}
     for repr_name in requests:
         if repr_name == "codes":
-            assert code_map is not None
+            assert value_set is not None
             if pa.types.is_dictionary(column.type):
                 column = pc.cast(column, column.type.value_type)
-            local = pc.dictionary_encode(column)
-            local_dict = local.dictionary.to_pylist()
-            lut = np.array(
-                [
-                    code_map.get(_canon_key(v), -1) if v is not None else -1
-                    for v in local_dict
-                ]
-                + [-1],
-                dtype=np.int32,
-            )
-            indices = pc.fill_null(
-                local.indices, pa.scalar(len(local_dict), local.indices.type)
-            ).to_numpy(zero_copy_only=False)
+            idx = pc.index_in(column, value_set=value_set)
+            idx = pc.fill_null(idx, pa.scalar(-1, idx.type))
             out["codes"] = np.ascontiguousarray(
-                lut[indices.astype(np.int64)]
+                idx.to_numpy(zero_copy_only=False).astype(np.int32)
             )
         else:
             out[repr_name] = convert_basic_repr(column, kind, repr_name)
@@ -110,7 +87,7 @@ class ParquetDataset(Dataset):
         self._num_rows = self._source.count_rows()
         self._materialized: Dict[str, np.ndarray] = {}
         self._dictionaries: Dict[str, np.ndarray] = {}
-        self._code_maps: Dict[str, Dict] = {}
+        self._value_sets: Dict[str, pa.Array] = {}
         self._null_counts: Dict[str, int] = {}
         self._device_cache: Dict = {}
         self._cache_key = id(self)
@@ -176,19 +153,55 @@ class ParquetDataset(Dataset):
 
     # -- global dictionaries (streaming pre-pass) -----------------------
 
-    def _collect_uniques(self, column: str, cap: Optional[int]) -> Optional[Dict]:
-        """Stream distinct values (canonical-keyed); None once > cap."""
-        uniques: Dict = {}
+    def _collect_uniques(
+        self, column: str, cap: Optional[int]
+    ) -> Optional[pa.Array]:
+        """Stream distinct non-null values, staying ENTIRELY in Arrow
+        (pc.unique per chunk, periodic compaction) — a Python set would
+        cost GBs at tens of millions of distinct values. Returns None
+        once the count provably exceeds ``cap``."""
+        base: Optional[pa.Array] = None  # already-deduped accumulator
+        fresh: List[pa.Array] = []  # per-batch uniques since last compact
+        fresh_n = 0
+
+        def compact() -> None:
+            nonlocal base, fresh, fresh_n
+            arrays = ([base] if base is not None else []) + fresh
+            base = pc.unique(pa.concat_arrays(arrays))
+            fresh = []
+            fresh_n = 0
+
         scanner = self._source.scanner(
             columns=[column], batch_size=self._read_batch_rows
         )
+        field_type = self._source.schema.field(column).type
+        if pa.types.is_dictionary(field_type):
+            field_type = field_type.value_type
         for batch in scanner.to_batches():
-            for v in pc.unique(batch.column(0)).to_pylist():
-                if v is not None:
-                    uniques.setdefault(_canon_key(v), v)
-            if cap is not None and len(uniques) > cap:
-                return None
-        return uniques
+            col = batch.column(0)
+            if pa.types.is_dictionary(col.type):
+                col = pc.cast(col, col.type.value_type)
+            u = pc.drop_null(pc.unique(col))
+            if len(u):
+                fresh.append(u)
+                fresh_n += len(u)
+            # compact on FRESH volume only (an accumulator already past
+            # the threshold must not trigger a full re-unique per batch),
+            # or when the optimistic total might prove the cap exceeded
+            over_cap_maybe = cap is not None and (
+                (0 if base is None else len(base)) + fresh_n > cap
+            )
+            if fresh_n > 4 * self._read_batch_rows or over_cap_maybe:
+                compact()
+                if cap is not None and len(base) > cap:
+                    return None
+        if fresh_n:
+            compact()
+        if base is None:
+            return pa.array([], field_type)
+        if cap is not None and len(base) > cap:
+            return None
+        return base
 
     def dictionary_size_within(self, column: str, cap: int):
         if column in self._dictionaries:
@@ -200,12 +213,9 @@ class ParquetDataset(Dataset):
         self._store_dictionary(column, uniques)
         return len(self._dictionaries[column])
 
-    def _store_dictionary(self, column: str, uniques: Dict) -> None:
-        ordered = sorted(uniques.values(), key=str)
-        self._dictionaries[column] = np.asarray(ordered, dtype=object)
-        self._code_maps[column] = {
-            _canon_key(v): i for i, v in enumerate(ordered)
-        }
+    def _store_dictionary(self, column: str, uniques: pa.Array) -> None:
+        self._value_sets[column] = uniques
+        self._dictionaries[column] = dictionary_to_numpy(uniques)
 
     def dictionary(self, column: str) -> np.ndarray:
         if column not in self._dictionaries:
@@ -214,9 +224,9 @@ class ParquetDataset(Dataset):
             )
         return self._dictionaries[column]
 
-    def _code_map(self, column: str) -> Dict:
+    def _dict_value_set(self, column: str) -> pa.Array:
         self.dictionary(column)
-        return self._code_maps[column]
+        return self._value_sets[column]
 
     # -- full-column materialization (resident path only) ---------------
 
@@ -236,14 +246,16 @@ class ParquetDataset(Dataset):
         reprs = self._reprs_for_kind(kind)
         if req.repr not in reprs:
             reprs = reprs + [req.repr]  # let the converter raise clearly
-        code_map = self._code_map(req.column) if "codes" in reprs else None
+        value_set = (
+            self._dict_value_set(req.column) if "codes" in reprs else None
+        )
         chunks: Dict[str, List[np.ndarray]] = {r: [] for r in reprs}
         scanner = self._source.scanner(
             columns=[req.column], batch_size=self._read_batch_rows
         )
         for batch in scanner.to_batches():
             out = _column_batch_to_reprs(
-                batch.column(0), kind, reprs, code_map
+                batch.column(0), kind, reprs, value_set
             )
             for r in reprs:
                 chunks[r].append(out[r])
@@ -255,7 +267,7 @@ class ParquetDataset(Dataset):
                     pa.array([], self._source.schema.field(req.column).type),
                     kind,
                     [r],
-                    code_map,
+                    value_set,
                 )[r]
             self._materialized[f"{req.column}::{r}"] = arr
         return self._materialized[key]
@@ -288,8 +300,8 @@ class ParquetDataset(Dataset):
             )
             return
         # pre-build dictionaries for code requests (streaming pre-pass)
-        code_maps = {
-            c: self._code_map(c)
+        value_sets = {
+            c: self._dict_value_set(c)
             for c, reprs in by_column.items()
             if "codes" in reprs
         }
@@ -340,7 +352,7 @@ class ParquetDataset(Dataset):
                     record_batch.column(ci),
                     kind,
                     by_column[column_name],
-                    code_maps.get(column_name),
+                    value_sets.get(column_name),
                 )
                 for repr_name, arr in reprs.items():
                     pending[f"{column_name}::{repr_name}"].append(arr)
@@ -354,14 +366,16 @@ class ParquetDataset(Dataset):
             batch: Dict[str, np.ndarray] = {}
             for k, r in keys.items():
                 kind = self._schema.kind_of(r.column)
-                code_map = (
-                    self._code_map(r.column) if r.repr == "codes" else None
+                value_set = (
+                    self._dict_value_set(r.column)
+                    if r.repr == "codes"
+                    else None
                 )
                 empty = _column_batch_to_reprs(
                     pa.array([], self._source.schema.field(r.column).type),
                     kind,
                     [r.repr],
-                    code_map,
+                    value_set,
                 )[r.repr]
                 batch[k] = np.zeros((batch_size,), dtype=empty.dtype)
             batch[ROW_MASK] = np.zeros((batch_size,), dtype=bool)

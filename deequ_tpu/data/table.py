@@ -47,6 +47,17 @@ def _synthesized_row_mask(nb: int, batch_size: int, n: int):
     return jax.jit(build)()
 
 
+def dictionary_to_numpy(dictionary: pa.Array) -> np.ndarray:
+    """Dictionary values as numpy: object arrays for strings, NATIVE
+    dtype otherwise — a to_pylist object array costs seconds at 10M
+    distinct values. One definition for the in-memory and parquet paths."""
+    if pa.types.is_string(dictionary.type) or pa.types.is_large_string(
+        dictionary.type
+    ):
+        return np.asarray(dictionary.to_pylist(), dtype=object)
+    return dictionary.to_numpy(zero_copy_only=False)
+
+
 def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
     """The ONE host->device conversion rule set for mask/values/lengths
     (codes need a dictionary and stay with their owner). Shared by the
@@ -302,19 +313,7 @@ class Dataset:
             .astype(np.int32)
         )
         self._materialized[f"{column}::codes"] = np.ascontiguousarray(codes)
-        dictionary = dict_arr.dictionary
-        if pa.types.is_string(dictionary.type) or pa.types.is_large_string(
-            dictionary.type
-        ):
-            self._dictionaries[column] = np.asarray(
-                dictionary.to_pylist(), dtype=object
-            )
-        else:
-            # numeric dictionaries stay native — a to_pylist object
-            # array costs seconds at 10M distinct values
-            self._dictionaries[column] = dictionary.to_numpy(
-                zero_copy_only=False
-            )
+        self._dictionaries[column] = dictionary_to_numpy(dict_arr.dictionary)
 
     # -- device materialization ----------------------------------------
 
