@@ -1,0 +1,131 @@
+"""Cross-cutting hardening: timestamp columns through the analyzers,
+anomaly-check wiring through the suite builder, and the profiler over a
+streamed parquet source."""
+
+import datetime
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu import (
+    Completeness,
+    Dataset,
+    InMemoryMetricsRepository,
+    Maximum,
+    Minimum,
+    RelativeRateOfChangeStrategy,
+    ResultKey,
+    Size,
+    VerificationSuite,
+)
+from deequ_tpu.data.table import Kind
+from deequ_tpu.profiles.profiler import ColumnProfiler
+
+
+class TestTimestampColumns:
+    @pytest.fixture(scope="class")
+    def ds(self):
+        base = datetime.datetime(2024, 1, 1)
+        stamps = [base + datetime.timedelta(days=i) for i in range(10)]
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "ts": pa.array(stamps, pa.timestamp("ms")),
+                    "ts_null": pa.array(
+                        stamps[:5] + [None] * 5, pa.timestamp("ms")
+                    ),
+                }
+            )
+        )
+
+    def test_kind(self, ds):
+        assert ds.schema.kind_of("ts") == Kind.TIMESTAMP
+
+    def test_min_max_reject_timestamps_like_reference(self, ds):
+        """The reference's Minimum/Maximum preconditions require numeric
+        columns (Spark's TimestampType is not) — a timestamp column
+        degrades to a failure metric, never a wrong answer."""
+        for metric in (
+            Minimum("ts").calculate(ds),
+            Maximum("ts").calculate(ds),
+        ):
+            assert metric.value.is_failure
+            assert "numeric" in str(metric.value.exception)
+
+    def test_completeness_with_nulls(self, ds):
+        assert Completeness("ts_null").calculate(ds).value.get() == 0.5
+
+
+class TestAnomalyCheckWiring:
+    def test_add_anomaly_check_flags_regression(self):
+        repo = InMemoryMetricsRepository()
+
+        def run(n, t):
+            return (
+                VerificationSuite()
+                .on_data(Dataset.from_pydict({"x": list(range(n))}))
+                .use_repository(repo)
+                .save_or_append_result(ResultKey.of(t))
+                .add_anomaly_check(
+                    RelativeRateOfChangeStrategy(
+                        max_rate_decrease=0.5, max_rate_increase=2.0
+                    ),
+                    Size(),
+                )
+                .run()
+            )
+
+        for t, n in enumerate([1000, 1100, 950, 1050]):
+            assert run(n, t).status.value in ("Success", "Warning") or t == 0
+        # 10x explosion must flag
+        assert run(11_000, 10).status.value != "Success"
+        # and a normal day after is fine again
+        assert run(10_900, 11).status.value in ("Success", "Warning")
+
+    def test_anomaly_check_requires_repository(self):
+        with pytest.raises(ValueError):
+            (
+                VerificationSuite()
+                .on_data(Dataset.from_pydict({"x": [1]}))
+                .add_anomaly_check(
+                    RelativeRateOfChangeStrategy(), Size()
+                )
+            )
+
+
+class TestProfilerOverParquet:
+    def test_profile_streamed_source(self, tmp_path):
+        rng = np.random.default_rng(11)
+        n = 20_000
+        table = pa.table(
+            {
+                "v": rng.normal(50, 5, n),
+                "qty_str": pa.array([str(i % 7) for i in range(n)]),
+                "label": pa.array(
+                    np.array(["x", "y", "z"])[rng.integers(0, 3, n)]
+                ),
+            }
+        )
+        path = os.path.join(tmp_path, "p.parquet")
+        pq.write_table(table, path)
+        streamed = ColumnProfiler.profile(Dataset.from_parquet(path))
+        in_memory = ColumnProfiler.profile(Dataset.from_arrow(table))
+        assert streamed.num_records == in_memory.num_records == n
+        for c in ("v", "qty_str", "label"):
+            s, m = streamed[c], in_memory[c]
+            assert s.data_type == m.data_type, c
+            assert s.completeness == m.completeness, c
+        # numeric-string promotion worked on the parquet path too
+        assert streamed["qty_str"].data_type == Kind.INTEGRAL
+        assert streamed["qty_str"].mean == pytest.approx(
+            in_memory["qty_str"].mean
+        )
+        # histograms agree
+        hs = streamed["label"].histogram
+        hm = in_memory["label"].histogram
+        assert {k: v.absolute for k, v in hs.values.items()} == {
+            k: v.absolute for k, v in hm.values.items()
+        }
