@@ -38,6 +38,7 @@ from deequ_tpu.data.table import (
     _kind_of,
     convert_basic_repr,
     dictionary_to_numpy,
+    narrow_codes,
 )
 
 
@@ -46,13 +47,17 @@ def _column_batch_to_reprs(
     kind: Kind,
     requests: List[str],
     value_set: Optional[pa.Array] = None,
+    values_dtype: Optional[np.dtype] = None,
 ) -> Dict[str, np.ndarray]:
     """Convert one record-batch column into the requested device reprs.
     mask/values/lengths share Dataset.materialize's conversion rules
     (table.convert_basic_repr); codes come from a vectorized
     ``pc.index_in`` against the dataset-global dictionary (Arrow treats
     NaN as equal to NaN, matching the in-memory dictionary_encode
-    path; nulls index to -1)."""
+    path; nulls index to -1). ``values_dtype`` applies the PER-COLUMN
+    wire-narrowing decision (from parquet statistics) — narrowing per
+    batch would make streamed batch dtypes unstable and recompile the
+    fused scan per dtype combination."""
     out: Dict[str, np.ndarray] = {}
     for repr_name in requests:
         if repr_name == "codes":
@@ -62,10 +67,16 @@ def _column_batch_to_reprs(
             idx = pc.index_in(column, value_set=value_set)
             idx = pc.fill_null(idx, pa.scalar(-1, idx.type))
             out["codes"] = np.ascontiguousarray(
-                idx.to_numpy(zero_copy_only=False).astype(np.int32)
+                narrow_codes(
+                    idx.to_numpy(zero_copy_only=False).astype(np.int32),
+                    len(value_set),
+                )
             )
         else:
-            out[repr_name] = convert_basic_repr(column, kind, repr_name)
+            arr = convert_basic_repr(column, kind, repr_name)
+            if repr_name == "values" and values_dtype is not None:
+                arr = arr.astype(values_dtype)
+            out[repr_name] = arr
     return out
 
 
@@ -146,6 +157,45 @@ class ParquetDataset(Dataset):
 
     def _is_all_valid(self, column: str) -> bool:
         return self._column_null_count(column) == 0
+
+    def _values_dtype(self, column: str) -> Optional[np.dtype]:
+        """Per-COLUMN wire-narrowing decision for int64 columns, from
+        parquet row-group min/max statistics (one decision for the whole
+        stream; see _column_batch_to_reprs). None = keep native."""
+        if not hasattr(self, "_values_dtypes"):
+            self._values_dtypes: Dict[str, Optional[np.dtype]] = {}
+        if column in self._values_dtypes:
+            return self._values_dtypes[column]
+        decision: Optional[np.dtype] = None
+        arrow_type = self._column_arrow_type(column)
+        if (
+            self._schema.kind_of(column) == Kind.INTEGRAL
+            and pa.types.is_integer(arrow_type)
+            and arrow_type.bit_width == 64
+        ):
+            lo, hi = None, None
+            known = True
+            idx = self._source.schema.get_field_index(column)
+            for fragment in self._source.get_fragments():
+                meta = fragment.metadata
+                for rg in range(meta.num_row_groups):
+                    stats = meta.row_group(rg).column(idx).statistics
+                    if (
+                        stats is None
+                        or not stats.has_min_max
+                        or stats.min is None
+                        or stats.max is None
+                    ):
+                        known = False
+                        break
+                    lo = stats.min if lo is None else min(lo, stats.min)
+                    hi = stats.max if hi is None else max(hi, stats.max)
+                if not known:
+                    break
+            if known and lo is not None and lo >= -(2**31) and hi < 2**31:
+                decision = np.dtype(np.int32)
+        self._values_dtypes[column] = decision
+        return decision
 
     def _column_arrow_type(self, column: str) -> pa.DataType:
         idx = self._source.schema.get_field_index(column)
@@ -253,9 +303,10 @@ class ParquetDataset(Dataset):
         scanner = self._source.scanner(
             columns=[req.column], batch_size=self._read_batch_rows
         )
+        values_dtype = self._values_dtype(req.column)
         for batch in scanner.to_batches():
             out = _column_batch_to_reprs(
-                batch.column(0), kind, reprs, value_set
+                batch.column(0), kind, reprs, value_set, values_dtype
             )
             for r in reprs:
                 chunks[r].append(out[r])
@@ -268,6 +319,7 @@ class ParquetDataset(Dataset):
                     kind,
                     [r],
                     value_set,
+                    values_dtype,
                 )[r]
             self._materialized[f"{req.column}::{r}"] = arr
         return self._materialized[key]
@@ -304,6 +356,11 @@ class ParquetDataset(Dataset):
             c: self._dict_value_set(c)
             for c, reprs in by_column.items()
             if "codes" in reprs
+        }
+        values_dtypes = {
+            c: self._values_dtype(c)
+            for c, reprs in by_column.items()
+            if "values" in reprs
         }
 
         pending: Dict[str, List[np.ndarray]] = {k: [] for k in keys}
@@ -353,6 +410,7 @@ class ParquetDataset(Dataset):
                     kind,
                     by_column[column_name],
                     value_sets.get(column_name),
+                    values_dtypes.get(column_name),
                 )
                 for repr_name, arr in reprs.items():
                     pending[f"{column_name}::{repr_name}"].append(arr)
@@ -376,6 +434,9 @@ class ParquetDataset(Dataset):
                     kind,
                     [r.repr],
                     value_set,
+                    self._values_dtype(r.column)
+                    if r.repr == "values"
+                    else None,
                 )[r.repr]
                 batch[k] = np.zeros((batch_size,), dtype=empty.dtype)
             batch[ROW_MASK] = np.zeros((batch_size,), dtype=bool)

@@ -4,11 +4,15 @@ This is deequ_tpu's L0/L1 replacement for Spark DataFrames (SURVEY.md §1,
 §7 stage 0). A :class:`Dataset` wraps a ``pyarrow.Table`` and materializes
 *device representations* of columns on demand:
 
-- ``values``   — numeric payload (nulls zero-filled; see mask)
+- ``values``   — numeric payload (nulls zero-filled; see mask); int64
+                 columns narrow to i32 when every value fits (wire
+                 bytes are the bottleneck)
 - ``mask``     — validity bitmap as bool (True = non-null), AND row mask
-- ``codes``    — dictionary codes (int32) for string/categorical columns,
-                 with the dictionary kept host-side (strings never reach
-                 the TPU — SURVEY.md §7 hard part #3)
+- ``codes``    — dictionary codes for string/categorical columns —
+                 i8/i16/i32 depending on dictionary size (widen before
+                 any joint-code arithmetic!) — with the dictionary kept
+                 host-side (strings never reach the TPU — SURVEY.md §7
+                 hard part #3)
 - ``lengths``  — utf8 lengths for string columns (MinLength/MaxLength)
 
 Batches are fixed-size and zero-padded (padding rows carry
@@ -45,6 +49,18 @@ def _synthesized_row_mask(nb: int, batch_size: int, n: int):
         return idx * batch_size + off < n
 
     return jax.jit(build)()
+
+
+def narrow_codes(codes: np.ndarray, dict_size: int) -> np.ndarray:
+    """Wire narrowing for dictionary codes: small dictionaries ship i8
+    or i16 instead of i32 (4x/2x fewer bytes over the bottleneck
+    host->device link). Bounds leave headroom for the +1 null-slot
+    shift in the grouping joint-code math; -1 (null) fits every width."""
+    if dict_size < 127:
+        return codes.astype(np.int8)
+    if dict_size < 32767:
+        return codes.astype(np.int16)
+    return codes
 
 
 def dictionary_to_numpy(dictionary: pa.Array) -> np.ndarray:
@@ -107,6 +123,21 @@ def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
             lengths.to_numpy(zero_copy_only=False).astype(np.int32)
         )
     raise ValueError(f"unknown column repr: {repr_name!r}")
+
+
+def narrow_int64_values(out: np.ndarray) -> np.ndarray:
+    """Wire narrowing: host->device bandwidth is the bottleneck; when
+    every value of an int64 column fits i32, ship half the bytes. Safe:
+    every consumer canonicalizes integrals (HLL hashes via int64,
+    sums/min/max widen to f64), so i32 and i64 storage of equal values
+    produce identical metrics and merge compatibly across datasets.
+    MUST be decided once per column (callers), never per batch — mixed
+    batch dtypes would force a recompile per dtype combination."""
+    if out.dtype == np.int64 and len(out):
+        lo, hi = out.min(), out.max()
+        if lo >= -(2**31) and hi < 2**31:
+            return out.astype(np.int32)
+    return out
 
 
 class Kind(enum.Enum):
@@ -312,6 +343,7 @@ class Dataset:
             .to_numpy(zero_copy_only=False)
             .astype(np.int32)
         )
+        codes = narrow_codes(codes, len(dict_arr.dictionary))
         self._materialized[f"{column}::codes"] = np.ascontiguousarray(codes)
         self._dictionaries[column] = dictionary_to_numpy(dict_arr.dictionary)
 
@@ -327,6 +359,8 @@ class Dataset:
         col = self._table.column(req.column)
         kind = self._schema.kind_of(req.column)
         out = convert_basic_repr(col, kind, req.repr)
+        if req.repr == "values" and kind == Kind.INTEGRAL:
+            out = narrow_int64_values(out)  # whole column: one decision
         self._materialized[key] = out
         return out
 
@@ -414,9 +448,14 @@ class Dataset:
     def _request_row_bytes(self, r: ColumnRequest) -> int:
         """Device bytes per row for one request (0 for synthesized);
         mirrors what materialize() actually produces, not the Arrow
-        storage width (timestamps/dates widen to int64, f16 to f32)."""
+        storage width (timestamps/dates widen to int64, f16 to f32;
+        codes/int64 values may be wire-narrowed). Unmaterialized
+        estimates are conservative upper bounds."""
         if r.repr == "mask":
             return 0 if self._synthesize_mask(r) else 1
+        cached = self._materialized.get(r.key)
+        if cached is not None:
+            return cached.dtype.itemsize  # the true narrowed width
         if r.repr in ("codes", "lengths"):
             return 4
         kind = self._schema.kind_of(r.column)
