@@ -112,6 +112,7 @@ from deequ_tpu.anomalydetection.strategies import (  # noqa: E402
 from deequ_tpu.anomalydetection.seasonal import (  # noqa: E402
     HoltWinters,
     MetricInterval,
+    SeasonalityModel,
     SeriesSeasonality,
 )
 from deequ_tpu.schema import (  # noqa: E402
@@ -187,6 +188,7 @@ __all__ = [
     "RowLevelSchema",
     "RowLevelSchemaValidator",
     "RunMetadata",
+    "SeasonalityModel",
     "profiler_trace",
     "SeriesSeasonality",
     "SimpleThresholdStrategy",

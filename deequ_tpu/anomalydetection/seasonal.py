@@ -46,6 +46,11 @@ def _period(interval: MetricInterval, seasonality: SeriesSeasonality) -> int:
     )
 
 
+class SeasonalityModel(enum.Enum):
+    ADDITIVE = "Additive"
+    MULTIPLICATIVE = "Multiplicative"
+
+
 def _holt_winters_additive(
     series: np.ndarray, period: int, alpha: float, beta: float, gamma: float
 ) -> Tuple[np.ndarray, float, float, np.ndarray]:
@@ -70,10 +75,52 @@ def _holt_winters_additive(
     return fitted, level, trend, season
 
 
+def _holt_winters_multiplicative(
+    series: np.ndarray, period: int, alpha: float, beta: float, gamma: float
+) -> Tuple[np.ndarray, float, float, np.ndarray]:
+    """Multiplicative-seasonality variant (reference:
+    seasonal/HoltWinters MultiplicativeSeasonality): season is a FACTOR
+    on the level, appropriate when seasonal swing scales with the
+    series magnitude. Requires a positive series."""
+    n = len(series)
+    base = float(series[:period].mean())
+    if base == 0:
+        base = 1e-12
+    season = (series[:period] / base).astype(float).copy()
+    level = base
+    trend = float(
+        (series[period : 2 * period].mean() - series[:period].mean()) / period
+    ) if n >= 2 * period else 0.0
+    fitted = np.empty(n)
+    for i in range(n):
+        s = season[i % period]
+        fitted[i] = (level + trend) * s
+        value = series[i]
+        safe_s = s if s != 0 else 1e-12
+        new_level = alpha * (value / safe_s) + (1 - alpha) * (level + trend)
+        trend = beta * (new_level - level) + (1 - beta) * trend
+        safe_level = new_level if new_level != 0 else 1e-12
+        season[i % period] = gamma * (value / safe_level) + (1 - gamma) * s
+        level = new_level
+    return fitted, level, trend, season
+
+
 def _forecast(
-    level: float, trend: float, season: np.ndarray, start: int, steps: int,
+    level: float,
+    trend: float,
+    season: np.ndarray,
+    start: int,
+    steps: int,
     period: int,
+    multiplicative: bool = False,
 ) -> np.ndarray:
+    if multiplicative:
+        return np.array(
+            [
+                (level + (h + 1) * trend) * season[(start + h) % period]
+                for h in range(steps)
+            ]
+        )
     return np.array(
         [
             level + (h + 1) * trend + season[(start + h) % period]
@@ -86,6 +133,12 @@ def _forecast(
 class HoltWinters(AnomalyDetectionStrategy):
     metric_interval: MetricInterval = MetricInterval.DAILY
     seasonality: SeriesSeasonality = SeriesSeasonality.WEEKLY
+    model: SeasonalityModel = SeasonalityModel.ADDITIVE
+
+    def _smooth(self, train, period, a, b, g):
+        if self.model == SeasonalityModel.MULTIPLICATIVE:
+            return _holt_winters_multiplicative(train, period, a, b, g)
+        return _holt_winters_additive(train, period, a, b, g)
 
     def _fit(
         self, train: np.ndarray, period: int
@@ -95,7 +148,7 @@ class HoltWinters(AnomalyDetectionStrategy):
         best_mse = math.inf
         grid = [0.05, 0.2, 0.4, 0.6, 0.8, 0.95]
         for a, b, g in itertools.product(grid, grid, grid):
-            fitted, *_ = _holt_winters_additive(train, period, a, b, g)
+            fitted, *_ = self._smooth(train, period, a, b, g)
             mse = float(np.mean((fitted - train) ** 2))
             if mse < best_mse:
                 best_mse, best = mse, (a, b, g)
@@ -103,7 +156,7 @@ class HoltWinters(AnomalyDetectionStrategy):
         a0, b0, g0 = best
         fine = lambda c: [max(0.01, c - 0.1), c, min(0.99, c + 0.1)]
         for a, b, g in itertools.product(fine(a0), fine(b0), fine(g0)):
-            fitted, *_ = _holt_winters_additive(train, period, a, b, g)
+            fitted, *_ = self._smooth(train, period, a, b, g)
             mse = float(np.mean((fitted - train) ** 2))
             if mse < best_mse:
                 best_mse, best = mse, (a, b, g)
@@ -120,13 +173,24 @@ class HoltWinters(AnomalyDetectionStrategy):
                 f"({2 * period} points) of history before the search "
                 f"interval, got {lo}"
             )
+        if (
+            self.model == SeasonalityModel.MULTIPLICATIVE
+            and np.any(values[:lo] <= 0)
+        ):
+            # only the TRAINING slice is divided by; a zero inside the
+            # search interval is a candidate anomaly, not a model error
+            raise ValueError(
+                "multiplicative Holt-Winters requires a positive "
+                "training series"
+            )
         train = values[:lo]
         (a, b, g), _ = self._fit(train, period)
-        fitted, level, trend, season = _holt_winters_additive(
-            train, period, a, b, g
-        )
+        fitted, level, trend, season = self._smooth(train, period, a, b, g)
         residual_sd = float(np.std(train - fitted))
-        forecasts = _forecast(level, trend, season, lo, hi - lo, period)
+        forecasts = _forecast(
+            level, trend, season, lo, hi - lo, period,
+            multiplicative=self.model == SeasonalityModel.MULTIPLICATIVE,
+        )
         bound = 1.96 * residual_sd
         out: List[Tuple[int, Anomaly]] = []
         for offset, i in enumerate(range(lo, hi)):

@@ -176,3 +176,46 @@ class TestAnomalyDetector:
         assert result.is_anomalous
         # anomaly reported against the new point's timestamp
         assert result.anomalies[0][0] == 4
+
+
+class TestHoltWintersMultiplicative:
+    def test_scaling_seasonal_series(self):
+        """Seasonal swing proportional to level: the multiplicative model
+        fits where the additive one underestimates the growing peaks."""
+        from deequ_tpu.anomalydetection.seasonal import SeasonalityModel
+
+        pattern = np.array([1.0, 1.5, 2.0, 1.5, 1.0, 0.5, 0.5])
+        weeks = 6
+        values = list(np.concatenate([pattern] * weeks) * 100.0
+                      * (1.0 + 0.02 * np.arange(weeks * 7)))
+        s = HoltWinters(
+            MetricInterval.DAILY,
+            SeriesSeasonality.WEEKLY,
+            model=SeasonalityModel.MULTIPLICATIVE,
+        )
+        clean = s.detect(values, search_interval=(35, 42))
+        assert clean == []
+        spiked = list(values)
+        spiked[38] *= 2.0
+        found = s.detect(spiked, search_interval=(35, 42))
+        assert indices(found) == [38]
+
+    def test_requires_positive_series(self):
+        from deequ_tpu.anomalydetection.seasonal import SeasonalityModel
+
+        s = HoltWinters(model=SeasonalityModel.MULTIPLICATIVE)
+        with pytest.raises(ValueError):
+            s.detect([0.0] * 30, search_interval=(14, 20))
+
+    def test_zero_inside_search_interval_is_an_anomaly(self):
+        """A collapse to zero in the forecast window must be REPORTED,
+        not rejected by the positivity guard (which applies to the
+        training slice only)."""
+        from deequ_tpu.anomalydetection.seasonal import SeasonalityModel
+
+        pattern = np.array([1.0, 1.5, 2.0, 1.5, 1.0, 0.5, 0.5])
+        values = list(np.concatenate([pattern] * 6) * 100.0)
+        values[38] = 0.0
+        s = HoltWinters(model=SeasonalityModel.MULTIPLICATIVE)
+        found = s.detect(values, search_interval=(35, 42))
+        assert 38 in indices(found)
