@@ -1,18 +1,21 @@
-"""Column profiling: the 3-pass pipeline.
+"""Column profiling pipeline.
 
 Reference: ``src/main/scala/com/amazon/deequ/profiles/`` (SURVEY.md
-§2.5, §3.3):
+§2.5, §3.3) runs THREE passes (generic stats; numeric stats; low-card
+histograms). Here the structure is tighter:
 
 - PASS 1 — one fused scan over ALL columns: Completeness,
-  ApproxCountDistinct, DataType (string columns);
-- type inference promotes numeric-looking string columns;
-- PASS 2 — second fused scan over numeric columns: Mean, Maximum,
-  Minimum, StandardDeviation, Sum (+ KLL percentiles when KLL profiling
-  is on);
-- PASS 3 — histograms for columns whose approx distinct count is below
-  the low-cardinality threshold (default 120). In deequ_tpu all pass-3
-  histograms share ONE scan (compute_many_frequencies), defusing the
-  reference's pass-3 job explosion (SURVEY.md §7 hard part #6).
+  ApproxCountDistinct, DataType (string columns), AND the numeric stats
+  (Mean/Max/Min/Sum/StdDev + optional KLL) for schema-native numeric
+  columns — those need nothing from pass 1's outputs, so fusing them
+  saves a whole data pass vs the reference (a full re-read on
+  streaming sources);
+- type inference promotes numeric-looking string columns; an OPTIONAL
+  extra scan computes numeric stats for just those promoted columns;
+- HISTOGRAM PASS — for columns whose approx distinct count is below
+  the low-cardinality threshold (default 120); all histograms share
+  ONE scan (compute_many_frequencies), defusing the reference's
+  pass-3 job explosion (SURVEY.md §7 hard part #6).
 
 This is the north-star benchmark workload (BASELINE.md).
 """
@@ -100,12 +103,36 @@ class ColumnProfiler:
                 raise KeyError(f"unknown column {c!r}")
 
         # ---- PASS 1: generic stats, one fused scan -------------------
+        # numeric stats for SCHEMA-native numeric columns ride the same
+        # scan (they need nothing from pass 1's outputs); the separate
+        # pass 2 below only handles promoted string columns, so a
+        # streaming source is read once less than the reference's
+        # 3-pass structure (SURVEY.md §3.3)
+        def numeric_analyzers(cols: Sequence[str]) -> List:
+            out: List = []
+            for c in cols:
+                out += [
+                    Mean(c), Maximum(c), Minimum(c), Sum(c),
+                    StandardDeviation(c),
+                ]
+                if kll_profiling:
+                    params = kll_parameters or KLLParameters()
+                    out.append(KLLSketch(c, params))
+                    out.append(
+                        ApproxQuantiles(c, _PERCENTILES, params=params)
+                    )
+            return out
+
+        numeric_native = [
+            c for c in columns if data.schema.kind_of(c).is_numeric
+        ]
         pass1: List = [Size()]
         for c in columns:
             pass1.append(Completeness(c))
             pass1.append(ApproxCountDistinct(c))
             if data.schema.kind_of(c) == Kind.STRING:
                 pass1.append(DataType(c))
+        pass1 += numeric_analyzers(numeric_native)
         ctx1 = AnalysisRunner.do_analysis_run(data, pass1, engine=engine)
 
         num_records = int(ctx1.metric(Size()).value.get_or_else(0.0))
@@ -140,46 +167,22 @@ class ColumnProfiler:
                 inferred[c] = False
                 type_counts[c] = {}
 
-        # ---- cast promoted string columns for pass 2 ------------------
-        numeric_native = [
-            c for c in columns if data.schema.kind_of(c).is_numeric
-        ]
+        # ---- PASS 2: promoted string columns only --------------------
         numeric_promoted = [
             c
             for c in columns
             if data.schema.kind_of(c) == Kind.STRING
             and kinds[c] in (Kind.INTEGRAL, Kind.FRACTIONAL)
         ]
-        promoted_data = (
-            _cast_string_columns(data, numeric_promoted)
-            if numeric_promoted
-            else None
-        )
-
-        # ---- PASS 2: numeric stats, one fused scan per dataset -------
-        def numeric_analyzers(cols: Sequence[str]) -> List:
-            out: List = []
-            for c in cols:
-                out += [
-                    Mean(c), Maximum(c), Minimum(c), Sum(c),
-                    StandardDeviation(c),
-                ]
-                if kll_profiling:
-                    params = kll_parameters or KLLParameters()
-                    out.append(KLLSketch(c, params))
-                    out.append(
-                        ApproxQuantiles(c, _PERCENTILES, params=params)
-                    )
-            return out
-
-        ctx2 = AnalysisRunner.do_analysis_run(
-            data, numeric_analyzers(numeric_native), engine=engine
-        )
-        if promoted_data is not None:
-            ctx2 = ctx2 + AnalysisRunner.do_analysis_run(
+        promoted_ctx = None
+        ctx2 = ctx1
+        if numeric_promoted:
+            promoted_data = _cast_string_columns(data, numeric_promoted)
+            promoted_ctx = AnalysisRunner.do_analysis_run(
                 promoted_data, numeric_analyzers(numeric_promoted),
                 engine=engine,
             )
+            ctx2 = ctx1 + promoted_ctx
 
         # ---- PASS 3: histograms for low-cardinality columns ----------
         # (ALL histograms share one scan via compute_many_frequencies)
@@ -245,8 +248,11 @@ class ColumnProfiler:
         from deequ_tpu.utils.observe import RunMetadata
 
         metadata = ctx1.run_metadata
-        for ctx in (ctx2, ctx3):
-            metadata = RunMetadata.merge_optional(metadata, ctx.run_metadata)
+        if promoted_ctx is not None:
+            metadata = RunMetadata.merge_optional(
+                metadata, promoted_ctx.run_metadata
+            )
+        metadata = RunMetadata.merge_optional(metadata, ctx3.run_metadata)
         return ColumnProfiles(profiles, num_records, run_metadata=metadata)
 
 
