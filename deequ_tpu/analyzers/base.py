@@ -155,6 +155,14 @@ StateTree = Any  # pytree of arrays (numpy host-side, jax inside jit)
 Batch = Dict[str, Any]
 
 
+class _CacheTokenAuto:
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return "<CACHE_TOKEN_AUTO>"
+
+
+CACHE_TOKEN_AUTO = _CacheTokenAuto()
+
+
 @dataclass
 class ScanOps:
     """The (identity, update, merge) triple for one analyzer, compiled
@@ -180,11 +188,48 @@ class ScanOps:
     host_init: Optional[Callable[[], Any]] = None
     host_fold: Optional[Callable[[Any, Any], Any]] = None
     consts: Optional[Dict[str, np.ndarray]] = None
+    # behavior fingerprint for the engine's cross-run plan cache: two
+    # ops with EQUAL tokens must trace to identical computations (all
+    # dataset-specific content rides `consts`).
+    # - CACHE_TOKEN_AUTO (default): the runner derives a standard token
+    #   for the built-in analyzers; the engine treats a still-AUTO op
+    #   as uncacheable.
+    # - None: EXPLICIT opt-out — never reuse a compiled plan containing
+    #   this op (dataset-derived constants baked into the closure).
+    cache_token: Optional[object] = CACHE_TOKEN_AUTO
 
     def apply_update(self, state, batch, consts):
         if self.consts is None:
             return self.update(state, batch)
         return self.update(state, batch, consts)
+
+
+def make_cache_token(
+    analyzer: "ScanShareableAnalyzer",
+    dataset: Dataset,
+    predicates: Sequence[Optional[str]] = (),
+) -> Optional[tuple]:
+    """Standard ScanOps.cache_token: the analyzer's repr (frozen
+    dataclass => deterministic, includes every parameter) plus the KINDS
+    of the involved columns (update closures branch on kind at build
+    time). None when any predicate bakes dictionary-derived constants
+    into its closure."""
+    from deequ_tpu.sql.predicate import compile_predicate
+
+    for expression in predicates:
+        if expression is None:
+            continue
+        if not compile_predicate(expression, dataset).dataset_independent:
+            return None
+    kinds = tuple(
+        sorted(
+            {
+                (r.column, dataset.schema.kind_of(r.column).value)
+                for r in analyzer.device_requests(dataset)
+            }
+        )
+    )
+    return (repr(analyzer), kinds)
 
 
 def pad_pow2(arr: np.ndarray, fill=0) -> np.ndarray:

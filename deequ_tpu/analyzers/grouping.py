@@ -321,11 +321,27 @@ def _make_dense_ops(
         ).astype(jnp_count)
         return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
 
+    token = None
+    if plan.where is None or compile_predicate(
+        plan.where, dataset
+    ).dataset_independent:
+        # closure content beyond consts: columns, padded_len, dtypes,
+        # null policy, the where expression
+        token = (
+            "dense-frequencies",
+            plan.columns,
+            plan.include_nulls,
+            plan.where,
+            padded_len,
+            str(np.dtype(code_dtype)),
+            str(np.dtype(count_dtype)),
+        )
     ops = ScanOps(
         init,
         update,
         lambda a, b: (a[0] + b[0], a[1] + b[1]),
         consts={"sizes": np.asarray(sizes, dtype=code_dtype)},
+        cache_token=token,
     )
     return requests, ops
 

@@ -314,11 +314,26 @@ def _run_scanning_analyzers(
     """Plan + run the fused scan; per-analyzer plan failures (bad
     predicate, unknown column inside an expression) degrade to failure
     metrics without aborting the shared pass."""
+    from deequ_tpu.analyzers.base import CACHE_TOKEN_AUTO, make_cache_token
+
     metrics: Dict[Analyzer, Metric] = {}
     planned: List[Tuple[ScanShareableAnalyzer, Any]] = []
     for analyzer in analyzers:
         try:
-            planned.append((analyzer, analyzer.make_ops(data)))
+            ops = analyzer.make_ops(data)
+            if ops.cache_token is CACHE_TOKEN_AUTO:
+                # generic behavior fingerprint (see ScanOps.cache_token);
+                # SQL expressions must be dictionary-independent for the
+                # compiled plan to be reusable across datasets
+                ops.cache_token = make_cache_token(
+                    analyzer,
+                    data,
+                    predicates=(
+                        getattr(analyzer, "where", None),
+                        getattr(analyzer, "predicate", None),
+                    ),
+                )
+            planned.append((analyzer, ops))
         except Exception as exc:  # noqa: BLE001
             metrics[analyzer] = analyzer.to_failure_metric(exc)
     if not planned:

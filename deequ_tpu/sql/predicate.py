@@ -380,6 +380,28 @@ class _Val:
     codes_of: Optional[str] = None  # column name whose dictionary applies
 
 
+class _PredicateData:
+    """What predicate evaluation may touch: the schema (strong) and the
+    dictionaries (weak — only string predicates dereference them, and
+    only at trace time while the owning run holds the dataset)."""
+
+    __slots__ = ("schema", "_ref")
+
+    def __init__(self, schema, ref):
+        self.schema = schema
+        self._ref = ref
+
+    def dictionary(self, column: str):
+        dataset = self._ref()
+        if dataset is None:  # pragma: no cover — contract violation
+            raise RuntimeError(
+                "string predicate outlived its dataset; string "
+                "predicates are only traced while the owning run holds "
+                "the data"
+            )
+        return dataset.dictionary(column)
+
+
 class CompiledPredicate:
     """A predicate compiled against a dataset's schema + dictionaries.
 
@@ -395,10 +417,35 @@ class CompiledPredicate:
         columns_used: Sequence[str],
         requests: Sequence[ColumnRequest],
     ):
+        import weakref
+
         self._node = node
-        self._dataset = dataset
+        # WEAK reference: compiled predicates end up inside jitted
+        # closures that the cross-run plan cache retains — a strong ref
+        # would pin the whole Arrow table for the cache's lifetime. The
+        # dataset is only dereferenced at TRACE time (schema lookups,
+        # dictionary lookups for string predicates), which happens while
+        # the owning run still holds the dataset.
+        self._dataset_ref = weakref.ref(dataset)
+        self._schema = dataset.schema
         self.columns_used = tuple(columns_used)
         self.requests = tuple(requests)
+        # a predicate touching NO string column evaluates identically on
+        # any dataset with the same schema kinds (no dictionary-derived
+        # constants get baked into its closure) — the engine's plan
+        # cache may reuse compiled scans across datasets only then
+        self.dataset_independent = all(
+            dataset.schema.kind_of(c) != Kind.STRING
+            for c in self.columns_used
+        )
+
+    @property
+    def _dataset(self) -> "_PredicateData":
+        # shim: schema strongly held (all a NUMERIC predicate touches,
+        # incl. on re-trace after the origin dataset is gone);
+        # dictionaries resolve through the weakref (string predicates
+        # only — those are never in cached cross-dataset plans)
+        return _PredicateData(self._schema, self._dataset_ref)
 
     def evaluate(self, batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
         val = _eval(self._node, batch, self._dataset)

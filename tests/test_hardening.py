@@ -129,3 +129,26 @@ class TestProfilerOverParquet:
         assert {k: v.absolute for k, v in hs.values.items()} == {
             k: v.absolute for k, v in hm.values.items()
         }
+
+
+class TestEngineSelectionFlag:
+    def test_cpu_engine_matches_default(self):
+        """config.engine='cpu' (the deequ.engine analog) places data on
+        the host platform and produces identical metrics."""
+        from deequ_tpu import Mean, StandardDeviation, config
+        from deequ_tpu.analyzers import AnalysisRunner
+
+        ds = Dataset.from_pydict(
+            {"x": list(np.random.default_rng(5).normal(0, 1, 50_000))}
+        )
+        analyzers = [Mean("x"), StandardDeviation("x"), Size()]
+        default_ctx = AnalysisRunner.do_analysis_run(ds, analyzers)
+        ds2 = Dataset.from_pydict(
+            {"x": list(np.asarray(ds.table.column("x")))}
+        )
+        with config.configure(engine="cpu"):
+            cpu_ctx = AnalysisRunner.do_analysis_run(ds2, analyzers)
+        for a in analyzers:
+            assert default_ctx.metric(a).value.get() == pytest.approx(
+                cpu_ctx.metric(a).value.get(), rel=1e-12
+            ), a

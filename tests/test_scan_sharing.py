@@ -3,6 +3,8 @@ analyzers trigger exactly ONE aggregation job by counting Spark jobs
 (SparkMonitor; SURVEY.md §4). The TPU equivalent: count compilations of
 the fused update — many analyzers, many batches, ONE trace."""
 
+import pytest
+
 from deequ_tpu.analyzers import (
     AnalysisRunner,
     Completeness,
@@ -35,7 +37,7 @@ def test_one_compile_for_many_analyzers_and_batches():
     )
     assert all(m.value.is_success for m in context.metric_map.values())
     # ONE fused computation for 9 analyzers over 7 batches
-    assert engine.trace_count == 1
+    assert engine.trace_count == 1 or engine.plan_cache_hit
 
 
 def test_batched_equals_single_batch():
@@ -116,3 +118,82 @@ class TestRunMetadata:
         # only exists for promoted string columns)
         names = [p.name for p in meta.passes]
         assert names == ["scan", "grouping"]
+
+
+class TestPlanCache:
+    """Cross-run plan reuse must NEVER change results: dataset content
+    (values, dictionaries) rides the arguments; dictionary-DEPENDENT
+    closures (string predicates) opt out via cache_token=None."""
+
+    def test_cached_plan_correct_across_datasets(self):
+        import numpy as np
+
+        from deequ_tpu import (
+            ApproxCountDistinct,
+            Dataset,
+            Histogram,
+            Mean,
+            PatternMatch,
+        )
+        from deequ_tpu.analyzers import AnalysisRunner, DataType
+        from deequ_tpu.engine import AnalysisEngine
+
+        def make(seed, cats):
+            rng = np.random.default_rng(seed)
+            return Dataset.from_pydict(
+                {
+                    "x": list(rng.normal(seed, 1, 5_000)),
+                    "s": list(rng.choice(cats, 5_000)),
+                }
+            )
+
+        analyzers = lambda: [
+            Mean("x"),
+            ApproxCountDistinct("s"),
+            PatternMatch("s", r"@"),
+            DataType("s"),
+            Histogram("s"),
+        ]
+        a = make(1, ["u@v", "nope", "x@y", "zz"])
+        b = make(2, ["all", "plain", "words"])  # different dictionary!
+        e1, e2 = AnalysisEngine(), AnalysisEngine()
+        ctx_a = AnalysisRunner.do_analysis_run(a, analyzers(), engine=e1)
+        ctx_b = AnalysisRunner.do_analysis_run(b, analyzers(), engine=e2)
+        # b's results reflect B's dictionary, not a leaked A LUT
+        assert ctx_b.metric(PatternMatch("s", r"@")).value.get() == 0.0
+        assert ctx_a.metric(PatternMatch("s", r"@")).value.get() > 0.2
+        assert ctx_b.metric(
+            ApproxCountDistinct("s")
+        ).value.get() == pytest.approx(3, abs=0.5)
+        hb = ctx_b.metric(Histogram("s")).value.get()
+        assert set(hb.values.keys()) == {"all", "plain", "words"}
+        # same plan structure: the second run REUSED the compiled scan
+        assert e2.plan_cache_hit
+
+    def test_string_predicates_are_not_cached(self):
+        from deequ_tpu import Compliance, Dataset
+        from deequ_tpu.analyzers import AnalysisRunner
+        from deequ_tpu.engine import AnalysisEngine
+
+        # same expression, different dictionaries -> different code
+        # constants in the closure; results must be per-dataset
+        a = Dataset.from_pydict({"s": ["hit", "miss", "hit", "miss"]})
+        b = Dataset.from_pydict({"s": ["miss", "miss", "hit", "miss"]})
+        ca = Compliance("c", "s = 'hit'")
+        va = AnalysisRunner.do_analysis_run(a, [ca]).metric(ca).value.get()
+        vb = AnalysisRunner.do_analysis_run(b, [ca]).metric(ca).value.get()
+        assert va == 0.5 and vb == 0.25
+
+    def test_numeric_predicates_cache_and_stay_correct(self):
+        from deequ_tpu import Compliance, Dataset
+        from deequ_tpu.analyzers import AnalysisRunner
+        from deequ_tpu.engine import AnalysisEngine
+
+        c = Compliance("pos", "x > 0 AND x % 2 = 0")
+        a = Dataset.from_pydict({"x": [2.0, -2.0, 4.0, 3.0]})
+        b = Dataset.from_pydict({"x": [1.0, 6.0, 8.0, 10.0]})
+        e1, e2 = AnalysisEngine(), AnalysisEngine()
+        va = AnalysisRunner.do_analysis_run(a, [c], engine=e1).metric(c)
+        vb = AnalysisRunner.do_analysis_run(b, [c], engine=e2).metric(c)
+        assert va.value.get() == 0.5
+        assert vb.value.get() == 0.75
