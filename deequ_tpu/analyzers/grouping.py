@@ -314,11 +314,17 @@ def _make_dense_ops(
         for j, c in enumerate(columns):
             shifted = (batch[f"{c}::codes"] + 1).astype(code_dtype)
             code = code * sizes_arr[j] + shifted  # null (-1) -> slot 0
-        # masked scatter-add; rejected rows go to the overflow slot
+        # masked scatter-add; rejected rows go to the overflow slot.
+        # The scatter MUST run in i32: under x64, jnp.bincount scatters
+        # in int64, which TPUs emulate at ~30x the i32 scatter cost
+        # (measured 148ms vs 5ms per 2M-row batch). Batches are far
+        # below 2^31 rows, so i32 per-batch counts are exact; the
+        # cross-batch carry add widens to the state dtype.
         code = jnp.where(keep, code, padded_len - 1)
-        counts = counts + jnp.bincount(
-            code, length=padded_len
-        ).astype(jnp_count)
+        per_batch = jnp.zeros(padded_len, dtype=jnp.int32).at[
+            jnp.clip(code, 0, padded_len - 1)
+        ].add(1)
+        counts = counts + per_batch.astype(jnp_count)
         return counts, num_rows + jnp.sum(keep, dtype=jnp.int64)
 
     token = None
