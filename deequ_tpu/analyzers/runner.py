@@ -313,51 +313,50 @@ def _run_scanning_analyzers(
 ) -> Dict[Analyzer, Metric]:
     """Plan + run the fused scan; per-analyzer plan failures (bad
     predicate, unknown column inside an expression) degrade to failure
-    metrics without aborting the shared pass."""
-    from deequ_tpu.analyzers.base import CACHE_TOKEN_AUTO, make_cache_token
+    metrics without aborting the shared pass. Same-family analyzers over
+    stackable columns ride vectorized group ops (engine/vectorize.py);
+    each member's ordinary state is sliced back out afterwards, so
+    persistence/merge semantics are identical to the single path."""
+    from deequ_tpu.engine.vectorize import plan_scan_units
 
     metrics: Dict[Analyzer, Metric] = {}
-    planned: List[Tuple[ScanShareableAnalyzer, Any]] = []
-    for analyzer in analyzers:
-        try:
-            ops = analyzer.make_ops(data)
-            if ops.cache_token is CACHE_TOKEN_AUTO:
-                # generic behavior fingerprint (see ScanOps.cache_token);
-                # SQL expressions must be dictionary-independent for the
-                # compiled plan to be reusable across datasets
-                ops.cache_token = make_cache_token(
-                    analyzer,
-                    data,
-                    predicates=(
-                        getattr(analyzer, "where", None),
-                        getattr(analyzer, "predicate", None),
-                    ),
-                )
-            planned.append((analyzer, ops))
-        except Exception as exc:  # noqa: BLE001
-            metrics[analyzer] = analyzer.to_failure_metric(exc)
-    if not planned:
+    units, plan_failures = plan_scan_units(data, analyzers)
+    for analyzer, exc in plan_failures.items():
+        metrics[analyzer] = analyzer.to_failure_metric(exc)
+    if not units:
         return metrics
 
     try:
-        states = engine.run_scan(data, planned)
+        states = engine.run_scan(
+            data, [(unit, unit.ops) for unit in units]
+        )
     except Exception as exc:  # noqa: BLE001
         wrapped = wrap_if_necessary(exc)
-        for analyzer, _ in planned:
-            metrics[analyzer] = analyzer.to_failure_metric(wrapped)
+        for unit in units:
+            for analyzer in unit.members:
+                metrics[analyzer] = analyzer.to_failure_metric(wrapped)
         return metrics
 
-    for (analyzer, ops), state in zip(planned, states):
-        try:
-            if aggregate_with is not None:
-                prior = aggregate_with.load(analyzer)
-                if prior is not None:
-                    state = ops.merge(state, prior)
-            if save_states_with is not None:
-                save_states_with.persist(analyzer, state)
-            metrics[analyzer] = analyzer.compute_metric_from_state(state)
-        except Exception as exc:  # noqa: BLE001
-            metrics[analyzer] = analyzer.to_failure_metric(exc)
+    for unit, unit_state in zip(units, states):
+        for member_idx, analyzer in enumerate(unit.members):
+            try:
+                if unit.extract is not None:
+                    state = unit.extract(unit_state, member_idx)
+                    merge = _merge_fn_for(state)
+                else:
+                    state = unit_state
+                    merge = unit.ops.merge
+                if aggregate_with is not None:
+                    prior = aggregate_with.load(analyzer)
+                    if prior is not None:
+                        state = merge(state, prior)
+                if save_states_with is not None:
+                    save_states_with.persist(analyzer, state)
+                metrics[analyzer] = analyzer.compute_metric_from_state(
+                    state
+                )
+            except Exception as exc:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(exc)
     return metrics
 
 

@@ -201,6 +201,30 @@ class ParquetDataset(Dataset):
         idx = self._source.schema.get_field_index(column)
         return self._source.schema.types[idx]
 
+    def request_dtype(self, req: ColumnRequest) -> np.dtype:
+        """Batch dtype WITHOUT materializing the stream: run the one
+        authoritative conversion (_column_batch_to_reprs) on a ZERO-ROW
+        column of the file's type, so any future change to the
+        conversion/narrowing rules is reflected here automatically."""
+        if req.repr == "mask":
+            return np.dtype(bool)
+        kind = self._schema.kind_of(req.column)
+        value_set = (
+            self._dict_value_set(req.column)
+            if req.repr == "codes"
+            else None
+        )
+        values_dtype = (
+            self._values_dtype(req.column)
+            if req.repr == "values"
+            else None
+        )
+        empty = pa.array([], type=self._column_arrow_type(req.column))
+        out = _column_batch_to_reprs(
+            empty, kind, [req.repr], value_set, values_dtype
+        )
+        return np.dtype(out[req.repr].dtype)
+
     # -- global dictionaries (streaming pre-pass) -----------------------
 
     def _collect_uniques(

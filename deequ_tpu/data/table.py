@@ -364,6 +364,15 @@ class Dataset:
         self._materialized[key] = out
         return out
 
+    def request_dtype(self, req: ColumnRequest) -> np.dtype:
+        """Dtype a device batch of this request will have (used by the
+        vectorizing planner to group stackable columns). In-memory
+        datasets answer from the (cached) materialization; streaming
+        sources override with their pre-decided per-column dtypes."""
+        if req.repr == "mask":
+            return np.dtype(bool)
+        return np.dtype(self.materialize(req).dtype)
+
     # -- batching -------------------------------------------------------
 
     def device_batches(

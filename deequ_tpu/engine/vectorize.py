@@ -1,0 +1,596 @@
+"""Vectorizing scan planner: stack same-kind columns into (C, B) ops.
+
+The fused scan (engine/scan.py) makes N analyzers cost ONE data pass,
+but each analyzer still lowers its own masked reduction: a 50-column
+profile emits hundreds of HLO reduce/scatter ops, which bloats XLA
+compile time (the dominant cold-start cost for big plans) and leaves
+per-kernel overhead on the table.
+
+This planner groups analyzers of the same FAMILY over columns of the
+same device dtype and the same ``where`` filter into one stacked op:
+
+- ``stats``        — Mean/Sum/Minimum/Maximum/StandardDeviation (values)
+                     and MinLength/MaxLength (lengths): one (C, B)
+                     masked reduction per needed statistic, Welford/Chan
+                     vectorized over the column axis;
+- ``completeness`` — Completeness: one (C, B) mask count;
+- ``hll``          — ApproxCountDistinct: hashes computed on the stacked
+                     block, registers updated with ONE scatter-max into
+                     a (C*M,) vector;
+- ``datatype``     — DataType over string columns: stacked code->bucket
+                     LUT gather + one scatter-add.
+
+Group states hold (C,)-shaped leaves; after the scan each member
+analyzer's ordinary state (states.py types) is SLICED back out, so
+metric finalization, state persistence, and incremental merge are
+unchanged. Numerics mirror the scalar paths in analyzers/basic.py
+exactly (same masked-neutral elements, same dtype widenings, same
+Welford/Chan batch merge) — only the reduction batching differs.
+
+Reference analog: deequ fuses analyzers into one ``df.agg`` but leaves
+per-expression evaluation to Tungsten (SURVEY.md §2.2); stacking is the
+TPU-shaped version of that fusion, feeding the VPU 8x32-lane grid full
+columns-by-rows tiles instead of one row stream per expression.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers import states as S
+from deequ_tpu.analyzers.base import ScanOps, pad_pow2
+from deequ_tpu.analyzers.basic import (
+    _compile_where,
+    _row_mask,
+    _acc_float,
+)
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind
+from deequ_tpu.sketches import hll
+
+_F64 = jnp.float64
+
+
+@dataclass
+class ScanUnit:
+    """One engine slot: either a single analyzer's ops or a vectorized
+    group. ``extract(state, member_index)`` slices a member's ordinary
+    state out of a group state (None for singles)."""
+
+    members: List[Any]  # analyzers, in column order
+    ops: ScanOps
+    requests: List[ColumnRequest]
+    extract: Optional[Callable[[Any, int], Any]] = None
+
+    # engine adapter: run_scan asks analyzers for device_requests
+    def device_requests(self, dataset: Dataset) -> List[ColumnRequest]:
+        return self.requests
+
+
+def _index_members(members: Sequence[Any]) -> Tuple[List[str], List[int]]:
+    """Dedup member columns preserving order; returns (columns,
+    member->column-index map)."""
+    columns: List[str] = []
+    col_index: Dict[str, int] = {}
+    for a in members:
+        if a.column not in col_index:
+            col_index[a.column] = len(columns)
+            columns.append(a.column)
+    return columns, [col_index[a.column] for a in members]
+
+
+def _stack_luts(luts: List[np.ndarray], fill=0) -> np.ndarray:
+    """Stack per-column LUTs into one (C, L) const, padding every LUT to
+    the group max and then to a power of two (see base.pad_pow2)."""
+    width = max(len(lut) for lut in luts)
+    return np.stack(
+        [
+            pad_pow2(
+                np.pad(lut, (0, width - len(lut)), constant_values=fill),
+                fill,
+            )
+            for lut in luts
+        ]
+    )
+
+
+def _where_ok_for_token(where: Optional[str], dataset: Dataset) -> bool:
+    if where is None:
+        return True
+    from deequ_tpu.sql.predicate import compile_predicate
+
+    return compile_predicate(where, dataset).dataset_independent
+
+
+def _group_token(
+    family: str,
+    dataset: Dataset,
+    columns: Sequence[str],
+    where: Optional[str],
+    extra: Tuple = (),
+) -> Optional[tuple]:
+    if not _where_ok_for_token(where, dataset):
+        return None
+    kinds = tuple(
+        (c, dataset.schema.kind_of(c).value) for c in columns
+    )
+    return ("vec", family, kinds, where, extra)
+
+
+# --------------------------------------------------------------------------
+# stats family
+# --------------------------------------------------------------------------
+
+_STATS_NEED = {
+    "Mean": ("sum",),
+    "Sum": ("sum",),
+    "Minimum": ("min",),
+    "Maximum": ("max",),
+    "MinLength": ("min",),
+    "MaxLength": ("max",),
+    "StandardDeviation": ("sum", "welford"),
+}
+
+
+def _build_stats_group(
+    dataset: Dataset,
+    members: List[Any],
+    repr_name: str,
+    where: Optional[str],
+) -> ScanUnit:
+    """members: stats analyzers sharing (repr, value dtype, where)."""
+    columns, member_cols = _index_members(members)
+    needs = set()
+    for a in members:
+        needs.update(_STATS_NEED[type(a).__name__])
+    where_fn, where_reqs = _compile_where(where, dataset)
+    requests = [
+        r
+        for c in columns
+        for r in (ColumnRequest(c, repr_name), ColumnRequest(c, "mask"))
+    ] + where_reqs
+    C = len(columns)
+    acc = _acc_float()
+    is_float = np.issubdtype(
+        dataset.request_dtype(ColumnRequest(columns[0], repr_name)),
+        np.floating,
+    )
+
+    def init():
+        state = {"n": np.zeros(C, dtype=np.int64)}
+        if "sum" in needs:
+            state["sum"] = np.zeros(C, dtype=np.dtype(acc))
+        if "min" in needs:
+            state["min"] = np.full(C, np.inf, dtype=np.float64)
+        if "max" in needs:
+            state["max"] = np.full(C, -np.inf, dtype=np.float64)
+        if "welford" in needs:
+            state["w"] = S.StandardDeviationState(
+                np.zeros(C), np.zeros(C), np.zeros(C)
+            )
+        return state
+
+    def update(state, batch):
+        x = jnp.stack([batch[f"{c}::{repr_name}"] for c in columns])
+        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        masks = masks & _row_mask(batch, where_fn)[None, :]
+        new = dict(state)
+        n_b = jnp.sum(masks, axis=1, dtype=jnp.int32).astype(jnp.int64)
+        new["n"] = state["n"] + n_b
+        sum_b = None
+        if "sum" in needs:
+            # mirrors basic._msum: float columns reduce in native dtype
+            # (scalar cast to acc after); integrals widen per element to
+            # f64 for exactness regardless of the accumulation knob
+            if is_float:
+                sum_b = jnp.sum(
+                    jnp.where(masks, x, jnp.zeros((), x.dtype)), axis=1
+                ).astype(acc)
+            else:
+                sum_b = jnp.sum(
+                    jnp.where(masks, x, 0).astype(_F64), axis=1
+                ).astype(acc)
+            new["sum"] = state["sum"] + sum_b
+        if "min" in needs:  # mirrors basic._mmin
+            neutral = (
+                jnp.array(jnp.inf, x.dtype)
+                if is_float
+                else jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
+            )
+            new["min"] = jnp.minimum(
+                state["min"],
+                jnp.min(jnp.where(masks, x, neutral), axis=1).astype(_F64),
+            )
+        if "max" in needs:  # mirrors basic._mmax
+            neutral = (
+                jnp.array(-jnp.inf, x.dtype)
+                if is_float
+                else jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+            )
+            new["max"] = jnp.maximum(
+                state["max"],
+                jnp.max(jnp.where(masks, x, neutral), axis=1).astype(_F64),
+            )
+        if "welford" in needs:
+            # mirrors StandardDeviation.make_ops batch-state + Chan
+            # merge, vectorized over the column axis
+            xw = x if is_float else x.astype(_F64)
+            nb_f = n_b.astype(_F64)
+            safe_nb = jnp.maximum(nb_f, 1.0)
+            mean_b = sum_b.astype(_F64) / safe_nb
+            dx = jnp.where(
+                masks, xw - mean_b.astype(xw.dtype)[:, None], 0
+            )
+            m2_b = jnp.sum(dx * dx, axis=1).astype(_F64)
+            batch_state = S.StandardDeviationState(
+                nb_f,
+                jnp.where(nb_f > 0, mean_b, 0.0),
+                jnp.where(nb_f > 0, m2_b, 0.0),
+            )
+            new["w"] = S.StandardDeviationState.merge(
+                state["w"], batch_state
+            )
+        return new
+
+    def merge(a, b):
+        out = {"n": a["n"] + b["n"]}
+        if "sum" in needs:
+            out["sum"] = a["sum"] + b["sum"]
+        if "min" in needs:
+            out["min"] = jnp.minimum(a["min"], b["min"])
+        if "max" in needs:
+            out["max"] = jnp.maximum(a["max"], b["max"])
+        if "welford" in needs:
+            out["w"] = S.StandardDeviationState.merge(a["w"], b["w"])
+        return out
+
+    def extract(state, member_idx: int):
+        i = member_cols[member_idx]
+        a = members[member_idx]
+        name = type(a).__name__
+        n = state["n"][i]
+        if name in ("Mean",):
+            return S.MeanState(state["sum"][i], n)
+        if name in ("Sum",):
+            return S.SumState(state["sum"][i], n)
+        if name in ("Minimum", "MinLength"):
+            return S.MinState(state["min"][i], n)
+        if name in ("Maximum", "MaxLength"):
+            return S.MaxState(state["max"][i], n)
+        w = state["w"]
+        return S.StandardDeviationState(w.n[i], w.avg[i], w.m2[i])
+
+    token = _group_token(
+        "stats",
+        dataset,
+        columns,
+        where,
+        extra=(repr_name, tuple(sorted(needs)), "f" if is_float else "i"),
+    )
+    return ScanUnit(
+        members,
+        ScanOps(init, update, merge, cache_token=token),
+        requests,
+        extract,
+    )
+
+
+# --------------------------------------------------------------------------
+# completeness family
+# --------------------------------------------------------------------------
+
+
+def _build_completeness_group(
+    dataset: Dataset, members: List[Any], where: Optional[str]
+) -> ScanUnit:
+    columns, member_cols = _index_members(members)
+    where_fn, where_reqs = _compile_where(where, dataset)
+    requests = [ColumnRequest(c, "mask") for c in columns] + where_reqs
+    C = len(columns)
+
+    def init():
+        return {
+            "matches": np.zeros(C, dtype=np.int64),
+            "rows": np.int64(0),
+        }
+
+    def update(state, batch):
+        rows = _row_mask(batch, where_fn)
+        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        valid = masks & rows[None, :]
+        return {
+            "matches": state["matches"]
+            + jnp.sum(valid, axis=1, dtype=jnp.int32).astype(jnp.int64),
+            "rows": state["rows"]
+            + jnp.sum(rows, dtype=jnp.int32).astype(jnp.int64),
+        }
+
+    def merge(a, b):
+        return {
+            "matches": a["matches"] + b["matches"],
+            "rows": a["rows"] + b["rows"],
+        }
+
+    def extract(state, member_idx: int):
+        return S.NumMatchesAndCount(
+            state["matches"][member_cols[member_idx]], state["rows"]
+        )
+
+    token = _group_token("completeness", dataset, columns, where)
+    return ScanUnit(
+        members,
+        ScanOps(init, update, merge, cache_token=token),
+        requests,
+        extract,
+    )
+
+
+# --------------------------------------------------------------------------
+# hll family
+# --------------------------------------------------------------------------
+
+
+def _build_hll_group(
+    dataset: Dataset,
+    members: List[Any],
+    value_repr: str,  # "values" (numeric) | "codes" (string)
+    where: Optional[str],
+) -> ScanUnit:
+    columns, member_cols = _index_members(members)
+    where_fn, where_reqs = _compile_where(where, dataset)
+    requests = [
+        r
+        for c in columns
+        for r in (ColumnRequest(c, value_repr), ColumnRequest(c, "mask"))
+    ] + where_reqs
+    C = len(columns)
+
+    consts = None
+    if value_repr == "codes":
+        luts1, luts2 = [], []
+        for c in columns:
+            h1, h2 = hll.dictionary_hash_pairs(dataset.dictionary(c))
+            luts1.append(h1)
+            luts2.append(h2)
+        consts = {"h1": _stack_luts(luts1), "h2": _stack_luts(luts2)}
+
+    def init():
+        return S.ApproxCountDistinctState(
+            np.zeros((C, hll.M), dtype=np.int32)
+        )
+
+    def update(state, batch, consts_in=None):
+        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        masks = masks & _row_mask(batch, where_fn)[None, :]
+        if value_repr == "codes":
+            codes = jnp.stack(
+                [batch[f"{c}::codes"] for c in columns]
+            ).astype(jnp.int32)
+            lut1, lut2 = consts_in["h1"], consts_in["h2"]
+            codes = jnp.clip(codes, 0, lut1.shape[1] - 1)
+            h1 = jnp.take_along_axis(lut1, codes, axis=1)
+            h2 = jnp.take_along_axis(lut2, codes, axis=1)
+        else:
+            x = jnp.stack([batch[f"{c}::values"] for c in columns])
+            h1, h2 = hll.hash_pair_numeric(x)
+        regs = hll.registers_from_hash_pair_stacked(h1, h2, masks)
+        return S.ApproxCountDistinctState(
+            jnp.maximum(state.registers, regs)
+        )
+
+    def extract(state, member_idx: int):
+        return S.ApproxCountDistinctState(
+            state.registers[member_cols[member_idx]]
+        )
+
+    token = _group_token(
+        "hll", dataset, columns, where, extra=(value_repr,)
+    )
+    return ScanUnit(
+        members,
+        ScanOps(
+            init,
+            update,
+            S.ApproxCountDistinctState.merge,
+            consts=consts,
+            cache_token=token,
+        ),
+        requests,
+        extract,
+    )
+
+
+# --------------------------------------------------------------------------
+# datatype family (string columns only)
+# --------------------------------------------------------------------------
+
+
+def _build_datatype_group(
+    dataset: Dataset, members: List[Any], where: Optional[str]
+) -> ScanUnit:
+    from deequ_tpu.analyzers.datatype import classify_string
+
+    columns, member_cols = _index_members(members)
+    where_fn, where_reqs = _compile_where(where, dataset)
+    requests = [
+        r
+        for c in columns
+        for r in (ColumnRequest(c, "codes"), ColumnRequest(c, "mask"))
+    ] + where_reqs
+    C = len(columns)
+
+    luts = []
+    for c in columns:
+        dictionary = dataset.dictionary(c)
+        lut = np.zeros(max(len(dictionary), 1), dtype=np.int32)
+        for i, value in enumerate(dictionary):
+            lut[i] = (
+                S.DataTypeHistogram.NULL
+                if value is None
+                else classify_string(str(value))
+            )
+        luts.append(lut)
+    consts = {"lut": _stack_luts(luts, S.DataTypeHistogram.STRING)}
+
+    def init():
+        return {"counts": np.zeros((C, 6), dtype=np.int64)}
+
+    def update(state, batch, consts_in):
+        table = consts_in["lut"]
+        rows = _row_mask(batch, where_fn)
+        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        valid = masks & rows[None, :]
+        codes = jnp.stack(
+            [batch[f"{c}::codes"] for c in columns]
+        ).astype(jnp.int32)
+        codes = jnp.clip(codes, 0, table.shape[1] - 1)
+        bucket = jnp.take_along_axis(table, codes, axis=1)
+        bucket = jnp.where(valid, bucket, S.DataTypeHistogram.NULL)
+        bucket = jnp.where(rows[None, :], bucket, 6)  # padding slot
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, bucket.shape, 0)
+        flat = (col_ids * 8 + bucket).ravel()
+        counts = (
+            jnp.zeros(C * 8, dtype=jnp.int32)
+            .at[flat]
+            .add(1)
+            .reshape(C, 8)[:, :6]
+        )
+        return {"counts": state["counts"] + counts.astype(jnp.int64)}
+
+    def merge(a, b):
+        return {"counts": a["counts"] + b["counts"]}
+
+    def extract(state, member_idx: int):
+        return S.DataTypeHistogram(
+            state["counts"][member_cols[member_idx]]
+        )
+
+    token = _group_token("datatype", dataset, columns, where)
+    return ScanUnit(
+        members,
+        ScanOps(init, update, merge, consts=consts, cache_token=token),
+        requests,
+        extract,
+    )
+
+
+# --------------------------------------------------------------------------
+# planner
+# --------------------------------------------------------------------------
+
+
+def plan_scan_units(
+    dataset: Dataset, analyzers: Sequence[Any]
+) -> Tuple[List[ScanUnit], Dict[Any, BaseException]]:
+    """Partition analyzers into vectorized groups + singles.
+
+    Returns (units, plan_failures). Grouping keys include the device
+    dtype of the stacked repr and the ``where`` expression; anything
+    unrecognized, host-folded, or oddly-typed falls back to its own
+    ``make_ops`` — behavior is identical either way.
+    """
+    from deequ_tpu.analyzers.basic import (
+        Completeness,
+        Maximum,
+        MaxLength,
+        Mean,
+        Minimum,
+        MinLength,
+        StandardDeviation,
+        Sum,
+    )
+    from deequ_tpu.analyzers.datatype import DataType
+    from deequ_tpu.analyzers.hll import ApproxCountDistinct
+
+    groups: Dict[tuple, List[Any]] = {}
+    singles: List[Any] = []
+    failures: Dict[Any, BaseException] = {}
+
+    def group_key(a) -> Optional[tuple]:
+        t = type(a)
+        try:
+            if t in (Mean, Sum, Minimum, Maximum, StandardDeviation):
+                dt = dataset.request_dtype(
+                    ColumnRequest(a.column, "values")
+                )
+                return ("stats", "values", str(dt), a.where)
+            if t in (MinLength, MaxLength):
+                return ("stats", "lengths", "int32", a.where)
+            if t is Completeness:
+                return ("completeness", a.where)
+            if t is ApproxCountDistinct:
+                kind = dataset.schema.kind_of(a.column)
+                if kind == Kind.STRING:
+                    dt = dataset.request_dtype(
+                        ColumnRequest(a.column, "codes")
+                    )
+                    return ("hll", "codes", str(dt), a.where)
+                dt = dataset.request_dtype(ColumnRequest(a.column, "values"))
+                return ("hll", "values", str(dt), a.where)
+            if (
+                t is DataType
+                and dataset.schema.kind_of(a.column) == Kind.STRING
+            ):
+                dt = dataset.request_dtype(ColumnRequest(a.column, "codes"))
+                return ("datatype", str(dt), a.where)
+        except Exception:  # noqa: BLE001 — fall back to the single path
+            return None
+        return None
+
+    for a in analyzers:
+        key = group_key(a)
+        if key is None:
+            singles.append(a)
+        else:
+            groups.setdefault(key, []).append(a)
+
+    units: List[ScanUnit] = []
+    for key, members in groups.items():
+        if len(members) == 1:
+            singles.extend(members)
+            continue
+        try:
+            if key[0] == "stats":
+                units.append(
+                    _build_stats_group(dataset, members, key[1], key[3])
+                )
+            elif key[0] == "completeness":
+                units.append(
+                    _build_completeness_group(dataset, members, key[1])
+                )
+            elif key[0] == "hll":
+                units.append(
+                    _build_hll_group(dataset, members, key[1], key[3])
+                )
+            else:
+                units.append(
+                    _build_datatype_group(dataset, members, key[2])
+                )
+        except Exception:  # noqa: BLE001 — vectorization is an
+            # optimization; degrade to the per-analyzer path
+            singles.extend(members)
+
+    from deequ_tpu.analyzers.base import CACHE_TOKEN_AUTO, make_cache_token
+
+    for a in singles:
+        try:
+            ops = a.make_ops(dataset)
+            if ops.cache_token is CACHE_TOKEN_AUTO:
+                ops.cache_token = make_cache_token(
+                    a,
+                    dataset,
+                    predicates=(
+                        getattr(a, "where", None),
+                        getattr(a, "predicate", None),
+                    ),
+                )
+            units.append(
+                ScanUnit([a], ops, a.device_requests(dataset), None)
+            )
+        except Exception as exc:  # noqa: BLE001
+            failures[a] = exc
+    return units, failures

@@ -99,6 +99,17 @@ def dictionary_hash_pairs(
     return h1, h2
 
 
+def _index_and_rank(h1, h2, mask):
+    """THE one (register index, rho rank) derivation — the single and
+    column-stacked update paths must share it: divergence here would put
+    equal values in different registers, and a max-merge of states from
+    the two paths would then double-count (the v1/v2 hazard documented
+    in analyzers/states.py STATE_FORMAT_VERSIONS)."""
+    idx = (h1 >> np.uint32(32 - P)).astype(jnp.int32)
+    rho = jnp.minimum(jax.lax.clz(h2) + 1, 33).astype(jnp.int32)
+    return jnp.where(mask, idx, 0), jnp.where(mask, rho, 0)
+
+
 def registers_from_hash_pair(
     h1: jnp.ndarray, h2: jnp.ndarray, mask: jnp.ndarray
 ) -> jnp.ndarray:
@@ -106,11 +117,26 @@ def registers_from_hash_pair(
 
     rho comes from h2's leading zeros (1..33) — supporting max register
     rank 33, ample for cardinalities far beyond 2^40."""
-    idx = (h1 >> np.uint32(32 - P)).astype(jnp.int32)
-    rho = jnp.minimum(jax.lax.clz(h2) + 1, 33).astype(jnp.int32)
-    rho = jnp.where(mask, rho, 0)
-    idx = jnp.where(mask, idx, 0)
+    idx, rho = _index_and_rank(h1, h2, mask)
     return jnp.zeros(M, dtype=jnp.int32).at[idx].max(rho)
+
+
+def registers_from_hash_pair_stacked(
+    h1: jnp.ndarray, h2: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Column-stacked variant: (C, B) hash pairs -> (C, M) registers via
+    ONE scatter-max into a flat (C*M,) vector (per-column register
+    blocks indexed by col*M + idx)."""
+    idx, rho = _index_and_rank(h1, h2, mask)
+    n_cols = idx.shape[0]
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, idx.shape, 0)
+    flat = (col_ids * M + idx).ravel()
+    return (
+        jnp.zeros(n_cols * M, dtype=jnp.int32)
+        .at[flat]
+        .max(rho.ravel())
+        .reshape(n_cols, M)
+    )
 
 
 _Q = 32  # h2 supplies 32 bits => register ranks 0..Q+1
