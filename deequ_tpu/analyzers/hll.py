@@ -2,7 +2,7 @@
 
 Reference: ``analyzers/ApproxCountDistinct.scala`` + the
 ``StatefulHyperloglogPlus`` Catalyst aggregate (SURVEY.md §2.2/§2.3).
-State = int32[2^14] registers; update = hash+clz+scatter-max inside the
+State = int8[2^14] registers; update = hash+clz+scatter-max inside the
 shared fused scan; merge = elementwise max (mesh all-reduce / persisted
 state merge). Nulls are ignored, matching the reference.
 """
@@ -57,7 +57,9 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
         kind = dataset.schema.kind_of(col)
 
         def init() -> ApproxCountDistinctState:
-            return ApproxCountDistinctState(np.zeros(hll.M, dtype=np.int32))
+            return ApproxCountDistinctState(
+                np.zeros(hll.M, dtype=np.int8)
+            )
 
         if kind == Kind.STRING:
             # hash LUTs as runtime inputs (pow2-padded): the compiled

@@ -237,8 +237,9 @@ class ApproxQuantiles(_KLLBase):
                     "Empty state for analyzer ApproxQuantiles."
                 )
             )
+        results = state.quantiles(self.quantiles)  # one sort for all qs
         values = {
-            str(q): state.quantile(q) for q in self.quantiles
+            str(q): value for q, value in zip(self.quantiles, results)
         }
         return KeyedDoubleMetric(
             Entity.COLUMN, "ApproxQuantiles", self.instance, Success(values)

@@ -223,12 +223,14 @@ class DataTypeHistogram(NamedTuple):
 
 
 class ApproxCountDistinctState(NamedTuple):
-    """HLL registers (int32[m]); merge = elementwise max (SURVEY.md §2.3:
-    the reference's StatefulHyperloglogPlus merges register words by
-    word-wise max — here the registers are a device vector and the merge
-    is a ``lax.max`` all-reduce)."""
+    """HLL registers (int8[m]; rho <= 33 — narrow dtype quarters the
+    wire bytes when states cross the tunnel); merge = elementwise max
+    (SURVEY.md §2.3: the reference's StatefulHyperloglogPlus merges
+    register words by word-wise max — here the registers are a device
+    vector and the merge is a ``lax.max`` all-reduce). States persisted
+    as int32 by older builds promote cleanly on merge."""
 
-    registers: jnp.ndarray  # int32[m]
+    registers: jnp.ndarray  # int8[m]
 
     @staticmethod
     def merge(

@@ -360,7 +360,7 @@ def _build_hll_group(
 
     def init():
         return S.ApproxCountDistinctState(
-            np.zeros((C, hll.M), dtype=np.int32)
+            np.zeros((C, hll.M), dtype=np.int8)
         )
 
     def update(state, batch, consts_in=None):
@@ -397,6 +397,119 @@ def _build_hll_group(
             update,
             S.ApproxCountDistinctState.merge,
             consts=consts,
+            cache_token=token,
+        ),
+        requests,
+        extract,
+    )
+
+
+# --------------------------------------------------------------------------
+# kll family (host-folded quantile sketches)
+# --------------------------------------------------------------------------
+
+
+def _build_kll_group(
+    dataset: Dataset, members: List[Any], where: Optional[str]
+) -> ScanUnit:
+    """KLLSketch/ApproxQuantile/ApproxQuantiles sharing (params, where):
+    ONE batched (C, B) sort + strided sampling per scan step instead of
+    C independent sorts; the host folds each column's samples into its
+    compactor hierarchy. Analyzers over the SAME column share one
+    sketch (kll_profiling runs KLLSketch + ApproxQuantiles per column —
+    the sort and the sketch are computed once)."""
+    from deequ_tpu.sketches.hll import fmix32
+    from deequ_tpu.sketches.kll import KLLSketchState
+
+    params = members[0].params
+    columns, member_cols = _index_members(members)
+    where_fn, where_reqs = _compile_where(where, dataset)
+    requests = [
+        r
+        for c in columns
+        for r in (ColumnRequest(c, "values"), ColumnRequest(c, "mask"))
+    ] + where_reqs
+    C = len(columns)
+    k = params.sketch_size
+
+    def init():
+        # per-batch output slot (overwritten each batch, not a carry)
+        return (
+            np.zeros((C, k), dtype=np.float32),  # samples
+            np.zeros((C, k), dtype=bool),  # sample validity
+            np.zeros(C, dtype=np.int64),  # valid counts
+            np.full(C, np.inf, dtype=np.float32),  # min
+            np.full(C, -np.inf, dtype=np.float32),  # max
+            np.zeros(C, dtype=np.int32),  # compaction level
+        )
+
+    def update(_state, batch):
+        # mirrors analyzers/kll._make_kll_ops exactly, vectorized over
+        # the column axis; the device kernel stays in f32/u32 lanes
+        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        masks = masks & _row_mask(batch, where_fn)[None, :]
+        x = jnp.stack(
+            [batch[f"{c}::values"].astype(jnp.float32) for c in columns]
+        )
+        masks = masks & jnp.isfinite(x)
+        B = x.shape[1]
+        sorted_x = jnp.sort(jnp.where(masks, x, jnp.inf), axis=1)
+        nv = jnp.sum(masks, axis=1, dtype=jnp.int64)
+        q = ((nv + k - 1) // k).astype(jnp.uint32)
+        level = jnp.where(
+            q > 1, 32 - jax.lax.clz(jnp.maximum(q - 1, 1)), 0
+        ).astype(jnp.int32)
+        stride = jnp.int64(1) << level.astype(jnp.int64)
+        bits = jax.lax.bitcast_convert_type(sorted_x[:, 0], jnp.uint32)
+        seed = fmix32(nv.astype(jnp.uint32) ^ bits)
+        offset = seed.astype(jnp.int64) & (stride - 1)
+        idx = offset[:, None] + jnp.arange(k, dtype=jnp.int64)[None, :] * (
+            stride[:, None]
+        )
+        valid = idx < nv[:, None]
+        samples = jnp.take_along_axis(
+            sorted_x, jnp.clip(idx, 0, B - 1), axis=1
+        )
+        mn = jnp.min(jnp.where(masks, x, jnp.inf), axis=1)
+        mx = jnp.max(jnp.where(masks, x, -jnp.inf), axis=1)
+        return (samples, valid, nv, mn, mx, level)
+
+    def host_init():
+        return [KLLSketchState(params) for _ in range(C)]
+
+    def host_fold(accs, out):
+        samples, valid, nv, mn, mx, level = out
+        for i in range(C):
+            accs[i].add_pre_compacted(
+                np.asarray(samples[i])[np.asarray(valid[i])],
+                int(level[i]),
+                int(nv[i]),
+                float(mn[i]),
+                float(mx[i]),
+            )
+        return accs
+
+    def merge(a, b):  # per-column sketch merge (incremental/mesh path)
+        return [KLLSketchState.merge(x, y) for x, y in zip(a, b)]
+
+    def extract(accs, member_idx: int):
+        return accs[member_cols[member_idx]]
+
+    token = _group_token(
+        "kll",
+        dataset,
+        columns,
+        where,
+        extra=(k, params.shrinking_factor),
+    )
+    return ScanUnit(
+        members,
+        ScanOps(
+            init,
+            update,
+            merge,
+            host_init=host_init,
+            host_fold=host_fold,
             cache_token=token,
         ),
         requests,
@@ -505,6 +618,11 @@ def plan_scan_units(
     )
     from deequ_tpu.analyzers.datatype import DataType
     from deequ_tpu.analyzers.hll import ApproxCountDistinct
+    from deequ_tpu.analyzers.kll import (
+        ApproxQuantile,
+        ApproxQuantiles,
+        KLLSketch,
+    )
 
     groups: Dict[tuple, List[Any]] = {}
     singles: List[Any] = []
@@ -537,6 +655,15 @@ def plan_scan_units(
             ):
                 dt = dataset.request_dtype(ColumnRequest(a.column, "codes"))
                 return ("datatype", str(dt), a.where)
+            if t in (KLLSketch, ApproxQuantile, ApproxQuantiles):
+                # values cast to f32 inside the kernel, so mixed input
+                # dtypes stack fine; sketches keyed by params + where
+                return (
+                    "kll",
+                    a.params.sketch_size,
+                    a.params.shrinking_factor,
+                    a.where,
+                )
         except Exception:  # noqa: BLE001 — fall back to the single path
             return None
         return None
@@ -565,6 +692,10 @@ def plan_scan_units(
             elif key[0] == "hll":
                 units.append(
                     _build_hll_group(dataset, members, key[1], key[3])
+                )
+            elif key[0] == "kll":
+                units.append(
+                    _build_kll_group(dataset, members, key[3])
                 )
             else:
                 units.append(

@@ -108,19 +108,24 @@ class ColumnProfiler:
         # pass 2 below only handles promoted string columns, so a
         # streaming source is read once less than the reference's
         # 3-pass structure (SURVEY.md §3.3)
+        params = kll_parameters or KLLParameters()
+
         def numeric_analyzers(cols: Sequence[str]) -> List:
             out: List = []
             for c in cols:
                 out += [
                     Mean(c), Maximum(c), Minimum(c), Sum(c),
                     StandardDeviation(c),
+                    # approx percentiles are part of the DEFAULT numeric
+                    # profile (reference pass 2 computes ApproxQuantiles
+                    # unconditionally, SURVEY.md §3.3); the full KLL
+                    # bucket distribution stays opt-in. Same params =>
+                    # the vectorized KLL group computes ONE sketch per
+                    # column serving both analyzers.
+                    ApproxQuantiles(c, _PERCENTILES, params=params),
                 ]
                 if kll_profiling:
-                    params = kll_parameters or KLLParameters()
                     out.append(KLLSketch(c, params))
-                    out.append(
-                        ApproxQuantiles(c, _PERCENTILES, params=params)
-                    )
             return out
 
         numeric_native = [
@@ -223,15 +228,14 @@ class ColumnProfiler:
                 target = c
                 percentiles = None
                 kll_dist = None
+                quantiles = metric_value(
+                    ApproxQuantiles(target, _PERCENTILES, params=params)
+                )
+                if quantiles is not None:
+                    percentiles = [
+                        quantiles[str(q)] for q in _PERCENTILES
+                    ]
                 if kll_profiling:
-                    params = kll_parameters or KLLParameters()
-                    quantiles = metric_value(
-                        ApproxQuantiles(target, _PERCENTILES, params=params)
-                    )
-                    if quantiles is not None:
-                        percentiles = [
-                            quantiles[str(q)] for q in _PERCENTILES
-                        ]
                     kll_dist = metric_value(KLLSketch(target, params))
                 profiles[c] = NumericColumnProfile(
                     **base,

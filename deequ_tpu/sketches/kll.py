@@ -191,17 +191,21 @@ class KLLSketchState:
 
     def quantile(self, q: float) -> float:
         """Smallest sketched value whose cumulative weight >= q * total."""
-        v, w = self._weighted_items()
-        if v.size == 0:
-            return math.nan
-        cum = np.cumsum(w)
-        target = q * cum[-1]
-        idx = int(np.searchsorted(cum, target, side="left"))
-        idx = min(idx, v.size - 1)
-        return float(v[idx])
+        return self.quantiles([q])[0]
 
     def quantiles(self, qs: Sequence[float]) -> List[float]:
-        return [self.quantile(q) for q in qs]
+        """All requested quantiles from ONE sort + cumsum: the default
+        profile asks for 99 percentiles per column, so per-call re-sorts
+        of the sketch would dominate host-side finalize time."""
+        v, w = self._weighted_items()
+        if v.size == 0:
+            return [math.nan for _ in qs]
+        cum = np.cumsum(w)
+        targets = np.asarray(list(qs), dtype=np.float64) * cum[-1]
+        idx = np.minimum(
+            np.searchsorted(cum, targets, side="left"), v.size - 1
+        )
+        return [float(x) for x in v[idx]]
 
     def rank(self, x: float) -> float:
         """Estimated number of items <= x."""

@@ -1,0 +1,163 @@
+"""Vectorized group ops (engine/vectorize.py) must be bit-equivalent to
+the per-analyzer scalar paths, and the default profile must carry
+approx percentiles (SURVEY.md §3.3 pass 2)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import (
+    ApproxCountDistinct,
+    ApproxQuantiles,
+    Completeness,
+    DataType,
+    Dataset,
+    KLLSketch,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.engine.vectorize import plan_scan_units
+from deequ_tpu.profiles.profiler import ColumnProfiler
+from deequ_tpu.sketches.kll import KLLParameters
+
+
+@pytest.fixture(scope="module")
+def ds():
+    rng = np.random.default_rng(42)
+    n = 5000
+    a = rng.normal(10.0, 3.0, n)
+    a[rng.integers(0, n, 200)] = np.nan
+    import pyarrow as pa
+
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "a": pa.array(a, pa.float64(), mask=np.isnan(a)),
+                "b": pa.array(rng.normal(-5, 1, n), pa.float64()),
+                "k": pa.array(rng.integers(0, 500, n, dtype=np.int64)),
+                "s": pa.array(
+                    np.resize(
+                        np.array(
+                            ["ab", "c", None, "12", "3.5", "true"],
+                            dtype=object,
+                        ),
+                        n,
+                    )
+                ),
+            }
+        )
+    )
+
+
+ANALYZERS = [
+    Mean("a"), Sum("a"), Minimum("a"), Maximum("a"), StandardDeviation("a"),
+    Mean("b"), Sum("b"), Minimum("b"), Maximum("b"), StandardDeviation("b"),
+    Mean("k"), Minimum("k"), Maximum("k"),
+    Completeness("a"), Completeness("b"), Completeness("s"),
+    ApproxCountDistinct("a"), ApproxCountDistinct("b"),
+    ApproxCountDistinct("k"), ApproxCountDistinct("s"),
+    DataType("s"), MinLength("s"), MaxLength("s"),
+    ApproxQuantiles("a", (0.25, 0.5, 0.75)),
+    ApproxQuantiles("b", (0.25, 0.5, 0.75)),
+]
+
+
+def test_planner_groups_families(ds):
+    units, failures = plan_scan_units(ds, ANALYZERS)
+    assert not failures
+    # far fewer units than analyzers: stats f64, stats i64, completeness,
+    # hll f64, hll i64, hll codes, datatype, lengths, kll + singles
+    assert len(units) < len(ANALYZERS) / 2
+    grouped = [u for u in units if u.extract is not None]
+    assert sum(len(u.members) for u in grouped) >= 20
+
+
+def test_vectorized_equals_individual(ds):
+    ctx = AnalysisRunner.do_analysis_run(ds, ANALYZERS)
+    # individual path: plan each analyzer alone (no grouping possible)
+    for analyzer in ANALYZERS:
+        solo = AnalysisRunner.do_analysis_run(ds, [analyzer])
+        grouped_metric = ctx.metric(analyzer)
+        solo_metric = solo.metric(analyzer)
+        assert grouped_metric.value.is_success, repr(analyzer)
+        gv, sv = grouped_metric.value.get(), solo_metric.value.get()
+        if isinstance(gv, dict):
+            assert gv.keys() == sv.keys()
+            for key in gv:
+                assert gv[key] == pytest.approx(sv[key], rel=1e-12), (
+                    analyzer,
+                    key,
+                )
+        elif isinstance(gv, float):
+            assert gv == pytest.approx(sv, rel=1e-12), repr(analyzer)
+        else:  # distributions
+            assert gv == sv, repr(analyzer)
+
+
+def test_kll_group_shares_sketch_per_column(ds):
+    params = KLLParameters()
+    units, _ = plan_scan_units(
+        ds, [KLLSketch("a", params), ApproxQuantiles("a", (0.5,), params=params)]
+    )
+    kll_units = [u for u in units if u.extract is not None]
+    assert len(kll_units) == 1
+    assert len(kll_units[0].members) == 2
+    # one column slot shared by both members
+    state = kll_units[0].ops.host_init()
+    assert len(state) == 1
+
+
+def test_default_profile_has_percentiles(ds):
+    profiles = ColumnProfiler.profile(ds)
+    prof = profiles["a"]
+    assert prof.approx_percentiles is not None
+    assert len(prof.approx_percentiles) == 99
+    # median of N(10, 3) with nulls skipped: near 10
+    assert prof.approx_percentiles[49] == pytest.approx(10.0, abs=0.5)
+    assert profiles["k"].approx_percentiles is not None
+    # string column has no percentiles
+    assert getattr(profiles["s"], "approx_percentiles", None) is None
+
+
+def test_group_states_persist_and_merge(ds, tmp_path):
+    from deequ_tpu import FileSystemStateProvider
+
+    half = ds.num_rows // 2
+    mask1 = np.zeros(ds.num_rows, dtype=bool)
+    mask1[:half] = True
+    d1, d2 = ds.filter_rows(mask1), ds.filter_rows(~mask1)
+    p1 = FileSystemStateProvider(str(tmp_path / "s1"))
+    p2 = FileSystemStateProvider(str(tmp_path / "s2"))
+    AnalysisRunner.do_analysis_run(d1, ANALYZERS, save_states_with=p1)
+    AnalysisRunner.do_analysis_run(d2, ANALYZERS, save_states_with=p2)
+    merged = AnalysisRunner.run_on_aggregated_states(
+        ds.schema, ANALYZERS, [p1, p2]
+    )
+    union = AnalysisRunner.do_analysis_run(ds, ANALYZERS)
+    for analyzer in ANALYZERS:
+        mv = merged.metric(analyzer).value
+        uv = union.metric(analyzer).value
+        assert mv.is_success, repr(analyzer)
+        m, u = mv.get(), uv.get()
+        # sketches (KLL quantiles, HLL) merge within their error bounds,
+        # not bit-identically; everything else must match exactly
+        sketchy = type(analyzer).__name__ in (
+            "ApproxQuantiles",
+            "ApproxQuantile",
+            "ApproxCountDistinct",
+            "KLLSketch",
+        )
+        rel = 2e-2 if sketchy else 1e-9
+        if isinstance(m, dict):
+            for key in m:
+                assert m[key] == pytest.approx(u[key], rel=rel, abs=0.2), (
+                    analyzer,
+                    key,
+                )
+        elif isinstance(m, float):
+            assert m == pytest.approx(u, rel=rel), repr(analyzer)
