@@ -23,6 +23,7 @@ and the fused analyzer scan compiles exactly once.
 from __future__ import annotations
 
 import enum
+import functools
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -34,21 +35,74 @@ import pyarrow.compute as pc
 
 ROW_MASK = "__row_mask__"
 
+# -- host->device transfer accounting (monotonic; bench snapshots it) ----
+_TRANSFER_BYTES = 0
 
-def _synthesized_row_mask(nb: int, batch_size: int, n: int):
-    """(nb, batch_size) bool mask of in-bounds rows, built ON device —
-    jitted so XLA fuses the iota into the comparison and only the
-    1-bit/row bool ever materializes (no wire transfer, no full-width
-    integer intermediate in HBM)."""
+
+def add_transfer_bytes(n: int) -> None:
+    global _TRANSFER_BYTES
+    _TRANSFER_BYTES += int(n)
+
+
+def transfer_bytes() -> int:
+    """Total bytes shipped host->device by the data layer so far.
+    Monotonic; callers snapshot around a run to decompose wall time into
+    link vs compute (VERDICT.md r2 weak #6)."""
+    return _TRANSFER_BYTES
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_row_mask_fn(chunk_nb: int, batch_size: int):
+    """Jitted builder of a (chunk_nb, batch_size) bool mask of in-bounds
+    rows for the chunk starting at global row ``start`` — built ON
+    device (iota fused into the comparison; no wire transfer). ``start``
+    and ``n`` are runtime scalars so one compile serves every chunk."""
     import jax
     import jax.numpy as jnp
 
-    def build():
-        idx = jax.lax.broadcasted_iota(jnp.int64, (nb, batch_size), 0)
-        off = jax.lax.broadcasted_iota(jnp.int64, (nb, batch_size), 1)
-        return idx * batch_size + off < n
+    def build(start, n):
+        idx = jax.lax.broadcasted_iota(jnp.int64, (chunk_nb, batch_size), 0)
+        off = jax.lax.broadcasted_iota(jnp.int64, (chunk_nb, batch_size), 1)
+        return start + idx * batch_size + off < n
 
-    return jax.jit(build)()
+    return jax.jit(build)
+
+
+def _unpack_mask_bits(packed, batch_size: int):
+    """Device: (chunk_nb, ceil(B/8)) uint8 little-endian packed bits ->
+    (chunk_nb, B) bool. Validity masks cross the wire at 1 BIT/row
+    (np.packbits host-side); this is the device-side expansion, fused by
+    XLA into the consuming reductions' pass."""
+    import jax.numpy as jnp
+
+    bits = (packed[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return bits.reshape(packed.shape[0], -1)[:, :batch_size].astype(bool)
+
+
+@functools.lru_cache(maxsize=None)
+def _mask_unpack_fn(batch_size: int):
+    import jax
+
+    return jax.jit(
+        functools.partial(_unpack_mask_bits, batch_size=batch_size)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _lengths_gather_fn():
+    """Device: utf8 lengths derived from dictionary codes via LUT gather
+    — string columns whose codes already ship (DataType/Histogram/HLL)
+    get MinLength/MaxLength inputs for FREE instead of 4 more bytes/row
+    over the wire. ``lut[0]`` is the null slot (length 0); codes are -1
+    for null, so gather at code+1."""
+    import jax
+    import jax.numpy as jnp
+
+    def gather(codes, lut):
+        idx = codes.astype(jnp.int32) + 1
+        return jnp.take(lut, jnp.clip(idx, 0, lut.shape[0] - 1), axis=0)
+
+    return jax.jit(gather)
 
 
 def narrow_codes(codes: np.ndarray, dict_size: int) -> np.ndarray:
@@ -72,6 +126,19 @@ def dictionary_to_numpy(dictionary: pa.Array) -> np.ndarray:
     ):
         return np.asarray(dictionary.to_pylist(), dtype=object)
     return dictionary.to_numpy(zero_copy_only=False)
+
+
+def dictionary_utf8_lengths(dictionary: pa.Array) -> np.ndarray:
+    """utf8 lengths of dictionary entries (null -> 0), i32 — computed by
+    Arrow's C++ kernel once per DISTINCT value, not per row."""
+    lengths = pc.fill_null(
+        pc.utf8_length(dictionary), pa.scalar(0, pa.int32())
+    )
+    if isinstance(lengths, pa.ChunkedArray):
+        lengths = lengths.combine_chunks()
+    return np.ascontiguousarray(
+        lengths.to_numpy(zero_copy_only=False).astype(np.int32)
+    )
 
 
 def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
@@ -229,6 +296,7 @@ class Dataset:
         )
         self._materialized: Dict[str, np.ndarray] = {}
         self._dictionaries: Dict[str, np.ndarray] = {}
+        self._dict_lengths: Dict[str, np.ndarray] = {}
         # device-resident stacked batches, keyed (repr key, batch, sharding)
         self._device_cache: Dict = {}
         self._cache_key = id(self)
@@ -346,6 +414,21 @@ class Dataset:
         codes = narrow_codes(codes, len(dict_arr.dictionary))
         self._materialized[f"{column}::codes"] = np.ascontiguousarray(codes)
         self._dictionaries[column] = dictionary_to_numpy(dict_arr.dictionary)
+        if self._schema.kind_of(column) == Kind.STRING:
+            self._dict_lengths[column] = dictionary_utf8_lengths(
+                dict_arr.dictionary
+            )
+
+    def dict_lengths(self, column: str) -> Optional[np.ndarray]:
+        """Per-dictionary-entry utf8 lengths (i32) for a string column,
+        or None when codes haven't been materialized. Used to derive
+        the 'lengths' device repr from codes on device (see
+        _lengths_gather_fn) instead of shipping 4 bytes/row."""
+        if column not in self._dict_lengths and column in self._dictionaries:
+            self._dict_lengths[column] = dictionary_utf8_lengths(
+                pa.array(list(self._dictionaries[column]), pa.string())
+            )
+        return self._dict_lengths.get(column)
 
     # -- device materialization ----------------------------------------
 
@@ -488,41 +571,93 @@ class Dataset:
         d = self.dictionary(column)
         return len(d) if len(d) <= cap else None
 
+    def _derived_length_codes(
+        self, keys: Dict[str, ColumnRequest]
+    ) -> List[ColumnRequest]:
+        """Codes requests the derived-lengths path would ADD to the
+        cache beyond the request set itself (a 'lengths' request served
+        by LUT gather pins the column's codes chunks too) — the budget
+        accounting must see them or eviction under-frees."""
+        extra = []
+        for r in keys.values():
+            if r.repr != "lengths":
+                continue
+            try:
+                if self._schema.kind_of(r.column) != Kind.STRING:
+                    continue
+            except KeyError:
+                continue
+            codes_key = f"{r.column}::codes"
+            if codes_key in keys:
+                continue
+            if (
+                codes_key in self._materialized
+                or r.column in self._dictionaries
+            ):
+                extra.append(ColumnRequest(r.column, "codes"))
+        return extra
+
     def estimated_device_bytes(
-        self, requests: Sequence[ColumnRequest], batch_size: int
+        self,
+        requests: Sequence[ColumnRequest],
+        batch_size: int,
+        chunk_batches: int = 1,
     ) -> int:
         """Upper-bound device bytes for the resident scan path (padded
-        rows; all-valid masks cost nothing — they alias the synthesized
-        row mask)."""
-        n = self.num_rows
-        nb = max(1, -(-n // batch_size))
-        padded = nb * batch_size
+        to whole chunks; all-valid masks cost nothing — they alias the
+        synthesized row mask; derived string lengths pin their codes
+        chunks too)."""
+        _, n_chunks = self._chunk_geometry(batch_size, chunk_batches)
+        padded = n_chunks * chunk_batches * batch_size
+        keys = self._dedup_requests(requests)
         per_row = 1  # synthesized row mask
-        for r in self._dedup_requests(requests).values():
+        for r in keys.values():
+            per_row += self._request_row_bytes(r)
+        for r in self._derived_length_codes(keys):
             per_row += self._request_row_bytes(r)
         return padded * per_row
+
+    def _chunk_geometry(
+        self, batch_size: int, chunk_batches: int
+    ) -> Tuple[int, int]:
+        """(num_batches, num_chunks). The last chunk is padded with
+        whole batches whose rows are all masked off (static chunk shape
+        -> one compile serves every chunk)."""
+        nb = self.num_batches(batch_size)
+        return nb, max(1, -(-nb // chunk_batches))
 
     def _uncached_bytes(
         self,
         requests: Sequence[ColumnRequest],
         batch_size: int,
+        chunk_batches: int,
         shard_key,
     ) -> int:
-        """Bytes this request set would ADD to the device cache (keys
-        already resident are free — the eviction test must not count
-        them, or re-scans of a cached set would evict themselves)."""
-        n = self.num_rows
-        nb = max(1, -(-n // batch_size))
-        padded = nb * batch_size
+        """DEVICE (HBM) bytes this request set would ADD to the cache
+        (keys already resident are free — the eviction test must not
+        count them, or re-scans of a cached set would evict themselves).
+        Masks count at their unpacked resident width (1 byte/row); wire
+        bytes are tracked separately via add_transfer_bytes."""
+        _, n_chunks = self._chunk_geometry(batch_size, chunk_batches)
+        chunk_rows = chunk_batches * batch_size
+        keys = self._dedup_requests(requests)
+        counted = dict(keys)
+        for r in self._derived_length_codes(keys):
+            counted.setdefault(r.key, r)
         total = 0
-        if (ROW_MASK, batch_size, shard_key) not in self._device_cache:
-            total += padded
-        for k, r in self._dedup_requests(requests).items():
-            if self._synthesize_mask(r):
-                continue
-            if (k, batch_size, shard_key) in self._device_cache:
-                continue
-            total += padded * self._request_row_bytes(r)
+        for ci in range(n_chunks):
+            if (
+                ROW_MASK, batch_size, chunk_batches, ci, shard_key
+            ) not in self._device_cache:
+                total += chunk_rows
+            for k, r in counted.items():
+                if self._synthesize_mask(r):
+                    continue
+                if (
+                    k, batch_size, chunk_batches, ci, shard_key
+                ) in self._device_cache:
+                    continue
+                total += chunk_rows * self._request_row_bytes(r)
         return total
 
     def _ensure_cache_budget(self, needed: int, budget: int) -> None:
@@ -544,78 +679,176 @@ class Dataset:
         if Dataset.global_device_cache_bytes() + needed > budget:
             self.clear_device_cache()
 
-    def device_scan_arrays(
+    def _host_chunk(
+        self, r: ColumnRequest, start_row: int, chunk_rows: int, batch_size: int
+    ) -> np.ndarray:
+        """(chunk_batches, batch_size) host array for one request's
+        chunk: a slice of the materialized column, zero-padded (padding
+        rows carry mask False exactly like the host batch path)."""
+        full = self.materialize(r)
+        n = len(full)
+        stop = min(start_row + chunk_rows, n)
+        sl = full[start_row:stop] if start_row < n else full[:0]
+        if len(sl) < chunk_rows:
+            sl = np.concatenate(
+                [sl, np.zeros((chunk_rows - len(sl),), dtype=full.dtype)]
+            )
+        return sl.reshape(-1, batch_size)
+
+    def device_scan_chunks(
         self,
         requests: Sequence[ColumnRequest],
         batch_size: int,
+        chunk_batches: int = 1,
         sharding=None,
         budget_bytes: int = 0,
-    ) -> Dict[str, "object"]:
+    ) -> Iterator[Dict[str, "object"]]:
         """Device-resident stacked batches for the fused ``lax.scan``
-        path: a dict of ``(num_batches, batch_size)`` jax arrays.
+        path, yielded chunk by chunk: each chunk is a dict of
+        ``(chunk_batches, batch_size)`` jax arrays.
 
-        Each column is transferred ONCE and cached (host->device
-        bandwidth is the engine's bottleneck; the profiler's multiple
-        passes re-read the same columns). Masks of all-valid columns and
-        the row mask are synthesized on device via iota — they never
-        cross the wire. Padding rows carry mask False exactly like the
-        host path. When adding this request set would push the resident
-        total past ``budget_bytes``, the whole cache is evicted first
-        (the new set alone is known to fit — the engine checks before
-        choosing this path).
+        Chunking is what lets a FRESH-data run overlap transfer with
+        compute: ``device_put`` and the per-chunk scan dispatch are both
+        async, so while the device crunches chunk i, chunk i+1's bytes
+        stream over the (bottleneck) host->device link — wall becomes
+        max(transfer, compute) instead of their sum (VERDICT.md r2 weak
+        #4). Every chunk is cached on device, so a re-scan replays from
+        HBM with zero transfers.
+
+        Wire-byte diet (the tunnel link is the engine's bottleneck):
+        - validity masks ship BIT-packed (np.packbits host-side, 8x
+          fewer bytes) and are expanded on device;
+        - masks of all-valid columns and the row mask are synthesized on
+          device via iota — they never cross the wire;
+        - string 'lengths' are derived on device from dictionary codes +
+          a tiny length LUT whenever the codes ship anyway.
+
+        When adding this request set would push the resident total past
+        ``budget_bytes``, older cache entries are evicted first (the new
+        set alone is known to fit — the engine checks before choosing
+        this path).
         """
         import jax
 
         n = self.num_rows
-        nb = max(1, -(-n // batch_size))
-        padded = nb * batch_size
+        nb, n_chunks = self._chunk_geometry(batch_size, chunk_batches)
+        chunk_rows = chunk_batches * batch_size
 
         # NamedSharding hashes by value, so equal shardings share entries
         shard_key = sharding
 
         if budget_bytes:
             self._ensure_cache_budget(
-                self._uncached_bytes(requests, batch_size, shard_key),
+                self._uncached_bytes(
+                    requests, batch_size, chunk_batches, shard_key
+                ),
                 budget_bytes,
             )
         self._touch_cache_registry()
 
         def put(host: np.ndarray):
+            add_transfer_bytes(host.nbytes)
             if sharding is not None:
                 return jax.device_put(host, sharding)
             return jax.device_put(host)
-        rm_key = (ROW_MASK, batch_size, shard_key)
-        if rm_key not in self._device_cache:
-            if sharding is not None:
-                idx_dtype = np.int64 if padded >= 2**31 else np.int32
-                row_mask = put(
-                    (np.arange(padded, dtype=idx_dtype) < n).reshape(
-                        nb, batch_size
-                    )
-                )
-            else:
-                row_mask = _synthesized_row_mask(nb, batch_size, n)
-            self._device_cache[rm_key] = row_mask
-            self._add_cache_bytes(padded)
-        row_mask = self._device_cache[rm_key]
 
-        out: Dict[str, object] = {ROW_MASK: row_mask}
-        for k, r in self._dedup_requests(requests).items():
-            if self._synthesize_mask(r):
-                out[k] = row_mask
-                continue
-            ck = (k, batch_size, shard_key)
-            if ck not in self._device_cache:
-                host = self.materialize(r)
-                if padded != n:
-                    host = np.concatenate(
-                        [host, np.zeros((padded - n,), dtype=host.dtype)]
+        keys = self._dedup_requests(requests)
+        # wire-free lengths: string columns whose codes ship anyway (or
+        # are already materialized) gather lengths from a LUT on device.
+        # Disabled under explicit sharding (LUT gather output placement
+        # would need its own annotation; the mesh path ships lengths).
+        derived_lengths: Dict[str, np.ndarray] = {}
+        if sharding is None:
+            for k, r in keys.items():
+                if r.repr != "lengths":
+                    continue
+                if self._schema.kind_of(r.column) != Kind.STRING:
+                    continue
+                codes_key = f"{r.column}::codes"
+                if codes_key in keys:
+                    # codes ship anyway: materialize them NOW so the
+                    # dictionary (and its length LUT) exists — without
+                    # this the branch only fired when some earlier
+                    # caller had happened to materialize codes first
+                    self.materialize(ColumnRequest(r.column, "codes"))
+                if (
+                    codes_key in self._materialized
+                    or r.column in self._dictionaries
+                ):
+                    lengths = self.dict_lengths(r.column)
+                    if lengths is not None:
+                        derived_lengths[r.column] = lengths
+
+        lut_cache: Dict[str, object] = {}
+        pack_masks = sharding is None
+
+        for ci in range(n_chunks):
+            start_row = ci * chunk_rows
+            rm_key = (ROW_MASK, batch_size, chunk_batches, ci, shard_key)
+            if rm_key not in self._device_cache:
+                if sharding is not None:
+                    idx = np.arange(
+                        start_row,
+                        start_row + chunk_rows,
+                        dtype=np.int64,
                     )
-                arr = put(host.reshape(nb, batch_size))
-                self._device_cache[ck] = arr
-                self._add_cache_bytes(host.nbytes)
-            out[k] = self._device_cache[ck]
-        return out
+                    row_mask = put((idx < n).reshape(-1, batch_size))
+                else:
+                    row_mask = _chunk_row_mask_fn(chunk_batches, batch_size)(
+                        np.int64(start_row), np.int64(n)
+                    )
+                self._device_cache[rm_key] = row_mask
+                self._add_cache_bytes(chunk_rows)
+            row_mask = self._device_cache[rm_key]
+
+            out: Dict[str, object] = {ROW_MASK: row_mask}
+            for k, r in keys.items():
+                if self._synthesize_mask(r):
+                    out[k] = row_mask
+                    continue
+                ck = (k, batch_size, chunk_batches, ci, shard_key)
+                if ck not in self._device_cache:
+                    if r.repr == "lengths" and r.column in derived_lengths:
+                        codes_req = ColumnRequest(r.column, "codes")
+                        codes_ck = (
+                            codes_req.key, batch_size, chunk_batches, ci,
+                            shard_key,
+                        )
+                        if codes_ck not in self._device_cache:
+                            codes_host = self._host_chunk(
+                                codes_req, start_row, chunk_rows, batch_size
+                            )
+                            self._device_cache[codes_ck] = put(codes_host)
+                            self._add_cache_bytes(codes_host.nbytes)
+                        if r.column not in lut_cache:
+                            lengths = derived_lengths[r.column]
+                            lut = np.concatenate(
+                                [np.zeros(1, np.int32), lengths]
+                            )
+                            lut_cache[r.column] = put(lut)
+                        arr = _lengths_gather_fn()(
+                            self._device_cache[codes_ck],
+                            lut_cache[r.column],
+                        )
+                    elif r.repr == "mask" and pack_masks:
+                        host = self._host_chunk(
+                            r, start_row, chunk_rows, batch_size
+                        )
+                        packed = np.packbits(
+                            host, axis=1, bitorder="little"
+                        )
+                        arr = _mask_unpack_fn(batch_size)(put(packed))
+                    else:
+                        host = self._host_chunk(
+                            r, start_row, chunk_rows, batch_size
+                        )
+                        arr = put(host)
+                    self._device_cache[ck] = arr
+                    self._add_cache_bytes(
+                        chunk_rows * self._request_row_bytes(r)
+                    )
+                out[k] = self._device_cache[ck]
+            yield out
 
     def clear_device_cache(self) -> None:
         self._device_cache.clear()
