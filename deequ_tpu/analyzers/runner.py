@@ -203,7 +203,7 @@ class AnalysisRunner:
                 metrics.update(
                     run_grouping_analyzers(
                         data, grouping, engine, aggregate_with,
-                        save_states_with,
+                        save_states_with, metadata=metadata,
                     )
                 )
 

@@ -1,0 +1,429 @@
+"""Device-side high-cardinality grouping: sort + segment counting.
+
+Reference context: the reference's grouping analyzers run a cluster
+shuffle (``groupBy().count()``, SURVEY.md §2.6); deequ_tpu's dense
+scatter-add path (analyzers/grouping.py) covers key spaces that fit a
+device count vector, and historically spilled anything larger to the
+host CPU's Arrow ``group_by`` — the one remaining Spark-job-shaped hole
+in the engine (SURVEY.md §7 hard part #1; VERDICT r2 missing #1).
+
+This module closes it for the common shape — ONE high-cardinality
+numeric grouping column (an id/key column under CountDistinct /
+Uniqueness / Distinctness / Entropy / Histogram): the TPU-native
+equivalent of the shuffle is a device **sort + segment-boundary count**.
+
+The sort uses a SINGLE u64 key lane — TPU sort compile time scales
+brutally with operand count (measured on v5e: 1-operand ~25s,
+3-operand 60-135s, both nearly flat in array length), so instead of
+carrying drop/null flags as extra sort keys:
+
+- int keys are XOR-biased into u64 (order-preserving, reversible);
+  rejected rows (padding, where-filter, nulls) map to the u64 sentinel
+  ``0xFFFF...`` and their EXACT count is kept as a scalar — after
+  counting, the sentinel-sharing segment is corrected by subtracting
+  that scalar, so even an int64.max key stays exact;
+- float32 keys are their RAW BITS (``bitcast f32->u32``, the one
+  bitcast width TPUs lower) widened to u64 — bit-grouping matches
+  Arrow's dictionary semantics exactly (-0.0 != +0.0; NaN payloads
+  canonicalized so NaN == NaN) and can never reach the sentinel;
+- float64 keys bitcast to u64 directly — only on backends whose X64
+  rewriter lowers 64-bit bitcasts (CPU); on TPU, f64 grouping columns
+  keep the host Arrow fallback (TPU demotes f64 anyway, so a device
+  path could not be bit-exact there);
+- the null group (Histogram's ``include_nulls``) is a separate scalar
+  count, re-inserted host-side — it never needs a key lane at all.
+
+Sorting by bits rather than value order is fine: grouping only needs
+EQUAL keys adjacent, and bit-equality is the grouping relation itself.
+
+Count-shaped metrics then finalize from ON-DEVICE scalars (#groups,
+#count==1, entropy, #rows) — a 10M-group state never crosses the
+tunnel; Histogram fetches only its top-K bins via ``lax.top_k``. The
+full (keys, counts) arrays stay device-resident and are fetched lazily
+only if something actually needs the values (persistence, incremental
+merge).
+
+No dictionary is built: unlike the dense path (host Arrow
+dictionary_encode) the keys here are the column's own 64-bit values, so
+a 1B-row id column never materializes a host-side distinct set at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
+
+_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+_BIAS = np.uint64(1) << np.uint64(63)
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_key_fn(key_kind: str, include_nulls: bool):
+    """Jitted: one scan chunk -> (flat u64 keys with sentinel for
+    non-contributing rows, #sentinel rows, #null rows kept).
+    ``key_kind``: "int" | "f32" | "f64" (see module docstring)."""
+
+    def build(values, mask, rows):
+        if key_kind == "f32":
+            x = values.astype(jnp.float32)
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+            # canonical NaN bits: Arrow dictionary_encode groups NaN==NaN
+            bits = jnp.where(
+                jnp.isnan(x), jnp.uint32(0x7FC00000), bits
+            )
+            keys = bits.astype(jnp.uint64)
+        elif key_kind == "f64":
+            x = values.astype(jnp.float64)
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+            keys = jnp.where(
+                jnp.isnan(x),
+                jnp.uint64(0x7FF8000000000000),
+                bits,
+            )
+        else:
+            keys = values.astype(jnp.int64).astype(jnp.uint64) ^ _BIAS
+        if include_nulls:
+            null = rows & ~mask
+            contributes = rows & mask
+        else:
+            null = jnp.zeros_like(rows)
+            contributes = rows & mask
+        keys = jnp.where(contributes, keys, _SENTINEL)
+        n_sentinel = jnp.sum(~contributes, dtype=jnp.int64)
+        n_null = jnp.sum(null, dtype=jnp.int64)
+        return keys.ravel(), n_sentinel, n_null
+
+    return jax.jit(build)
+
+
+@functools.lru_cache(maxsize=None)
+def _finalize_fn():
+    """Jitted: flat u64 keys + sentinel count -> per-group arrays and
+    scalars. Output arrays have length N+1 (slot N absorbs non-boundary
+    scatter writes); value groups occupy slots [0, num_segments) with
+    the sentinel-sharing segment's count corrected (possibly to 0).
+    Counts are i32 (a chip processes < 2^31 rows per state; cross-state
+    merges widen)."""
+
+    def run(keys, n_sentinel):
+        n = keys.shape[0]
+        k = jnp.sort(keys)  # ONE sort operand: see module docstring
+        boundary = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+        )
+        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        num_segments = seg[-1] + 1
+        counts = jnp.zeros(n + 1, dtype=jnp.int32).at[seg].add(1)
+        # sentinel correction: all non-contributing rows sorted to the
+        # end and share the last segment with any legit int64.max rows
+        has_sentinel = k[-1] == _SENTINEL
+        counts = counts.at[seg[-1]].add(
+            -jnp.where(has_sentinel, n_sentinel, 0).astype(jnp.int32)
+        )
+        group_keys = (
+            jnp.zeros(n + 1, dtype=keys.dtype)
+            .at[jnp.where(boundary, seg, n)]
+            .set(k)
+        )
+        in_range = jnp.arange(n + 1, dtype=jnp.int32) < num_segments
+        gmask = in_range & (counts > 0)
+        num_groups = jnp.sum(gmask, dtype=jnp.int64)
+        total = (n - n_sentinel).astype(jnp.int64)
+        unique = jnp.sum((counts == 1) & gmask, dtype=jnp.int64)
+        # entropy over value groups (all non-null by construction)
+        c = jnp.where(gmask, counts, 0).astype(jnp.float64)
+        tot_f = jnp.maximum(total, 1).astype(jnp.float64)
+        p = c / tot_f
+        entropy = -jnp.sum(jnp.where(c > 0, p * jnp.log(p), 0.0))
+        scalars = {
+            "num_segments": num_segments.astype(jnp.int64),
+            "num_groups": num_groups,
+            "total": total,
+            "unique": unique,
+            "entropy": entropy,
+        }
+        return scalars, group_keys, counts
+
+    return jax.jit(run)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _topk_fn(counts, group_keys, num_segments, k):
+    in_range = (
+        jnp.arange(counts.shape[0], dtype=jnp.int32) < num_segments
+    )
+    tc, ti = jax.lax.top_k(jnp.where(in_range, counts, -1), k)
+    return tc, jnp.take(group_keys, ti)
+
+
+class DeviceFrequencies(FrequenciesAndNumRows):
+    """FrequenciesAndNumRows whose groups live ON DEVICE.
+
+    Count metrics read precomputed scalars; ``keys``/``counts`` fetch
+    and decode lazily (only persistence, incremental merge, and
+    MutualInformation ever need the values). The null group, if any, is
+    a host scalar appended on access."""
+
+    def __init__(
+        self,
+        columns: Tuple[str, ...],
+        values_dtype: np.dtype,
+        scalars: Dict[str, object],
+        group_keys,
+        counts,
+        null_rows: int,
+        include_nulls: bool,
+    ):
+        self.columns = tuple(columns)
+        self._values_dtype = np.dtype(values_dtype)
+        self._is_float = self._values_dtype.kind == "f"
+        self._num_segments = int(scalars["num_segments"])
+        self._value_groups = int(scalars["num_groups"])
+        self._unique = int(scalars["unique"])
+        self._entropy = float(scalars["entropy"])
+        self._null_rows = int(null_rows) if include_nulls else 0
+        self._include_nulls = include_nulls
+        self.num_rows = int(scalars["total"]) + self._null_rows
+        self._dev = (group_keys, counts)
+        self._keys_host: Optional[np.ndarray] = None
+        self._counts_host: Optional[np.ndarray] = None
+
+    # -- FrequenciesAndNumRows surface ---------------------------------
+
+    @property
+    def _has_null_group(self) -> bool:
+        return self._null_rows > 0
+
+    @property
+    def num_groups(self) -> int:
+        return self._value_groups + (1 if self._has_null_group else 0)
+
+    def _fetch(self) -> None:
+        if self._counts_host is None:
+            from deequ_tpu.engine.pack import packed_device_get
+
+            gk, c = packed_device_get(self._dev)
+            s = self._num_segments
+            raw_keys = np.asarray(gk)[:s]
+            raw_counts = np.asarray(c)[:s]
+            live = raw_counts > 0  # drops a zeroed sentinel segment
+            self._keys_host = raw_keys[live]
+            self._counts_host = raw_counts[live].astype(np.int64)
+
+    def _decode_keys(self, raw: np.ndarray) -> np.ndarray:
+        """(K,) raw u64 keys -> (K,) object values in the column's OWN
+        dtype — a float32 column's keys must decode to np.float32, or
+        Histogram labels and persisted keys would diverge from the
+        dense dictionary path (str(np.float64(1.1)) !=
+        str(np.float32(1.1))). Float keys are raw bits; ints unbias."""
+        if self._values_dtype == np.float32:
+            vals = raw.astype(np.uint32).view(np.float32)
+        elif self._values_dtype == np.float64:
+            vals = raw.view(np.float64)
+        elif self._is_float:  # f16 materialized as f32 on the wire
+            vals = raw.astype(np.uint32).view(np.float32).astype(
+                self._values_dtype
+            )
+        else:
+            vals = (raw ^ _BIAS).view(np.int64)
+        return vals.astype(object)
+
+    @property
+    def counts(self) -> np.ndarray:
+        self._fetch()
+        if self._has_null_group:
+            return np.concatenate(
+                [self._counts_host, [np.int64(self._null_rows)]]
+            )
+        return self._counts_host
+
+    @property
+    def keys(self) -> np.ndarray:
+        self._fetch()
+        n = self.num_groups
+        out = np.empty((n, 1), dtype=object)
+        out[: len(self._keys_host), 0] = self._decode_keys(self._keys_host)
+        if self._has_null_group:
+            out[-1, 0] = None
+        return out
+
+    def non_null_group_mask(self) -> np.ndarray:
+        mask = np.ones(self.num_groups, dtype=bool)
+        if self._has_null_group:
+            mask[-1] = False
+        return mask
+
+    # -- fast paths (no device->host group transfer) -------------------
+
+    def count_unique_groups(self) -> int:
+        return self._unique + (1 if self._null_rows == 1 else 0)
+
+    def entropy_nats(self) -> float:
+        from deequ_tpu.analyzers.base import EmptyStateException
+
+        if self.num_rows - self._null_rows == 0:
+            raise EmptyStateException("Entropy over empty distribution.")
+        return self._entropy
+
+    def top_groups(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        gk, c = self._dev
+        kk = min(k, self._num_segments)
+        pairs = []
+        if kk > 0:
+            from deequ_tpu.engine.pack import packed_device_get
+
+            tc, tkeys = packed_device_get(
+                _topk_fn(c, gk, np.int32(self._num_segments), kk)
+            )
+            tc = np.asarray(tc)
+            live = tc > 0  # zeroed sentinel segment never bins
+            decoded = self._decode_keys(np.asarray(tkeys)[live])
+            pairs = list(zip(decoded, tc[live].astype(np.int64)))
+        if self._has_null_group:
+            pairs.append((None, np.int64(self._null_rows)))
+            pairs.sort(key=lambda kv: -kv[1])
+            pairs = pairs[:k]
+        if not pairs:
+            return np.zeros(0, dtype=object), np.zeros(0, dtype=np.int64)
+        keys_out = np.empty(len(pairs), dtype=object)
+        keys_out[:] = [p[0] for p in pairs]
+        return keys_out, np.asarray([p[1] for p in pairs], dtype=np.int64)
+
+
+def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
+    """True when a frequency plan should run the device sort path:
+    a single INTEGRAL/FRACTIONAL grouping column whose flat sort fits
+    the device budget. Strings keep the dense/Arrow path (their keys
+    are dictionary codes); booleans and timestamps keep it too so
+    decoded key VALUES (True/False, datetime64) stay merge-compatible
+    with dense-path states; uint64 can't widen to the i64 key lane.
+
+    Note the asymmetry with the dense path: dense must first build a
+    host-side dictionary (an Arrow hash pass over every row) just to
+    LEARN the cardinality; the sort path needs no dictionary at all,
+    so for numeric columns it wins even at low cardinality."""
+    from deequ_tpu import config
+
+    opts = config.options()
+    if not opts.device_spill_grouping:
+        return False
+    if not opts.device_cache_bytes:
+        return False  # chunked device path needs the resident cache
+    if engine is not None and engine.mesh is not None:
+        return False  # sharded sort needs an all_to_all re-shard (TODO)
+    if opts.engine == "cpu":
+        return False  # honor the engine-selection flag's placement
+    if dataset.num_rows >= 2**31:
+        return False  # i32 segment counts; the dense path widens, we gate
+    if len(plan.columns) != 1:
+        return False
+    column = plan.columns[0]
+    kind = dataset.schema.kind_of(column)
+    if kind not in (Kind.INTEGRAL, Kind.FRACTIONAL):
+        return False
+    try:
+        dt = dataset.request_dtype(ColumnRequest(column, "values"))
+    except Exception:  # noqa: BLE001 — odd column: use the host path
+        return False
+    if dt.kind == "u" and dt.itemsize == 8:
+        return False
+    if dt.kind == "f" and np.dtype(dt).itemsize == 8:
+        # f64 keys need a 64-bit bitcast, which only CPU-class backends
+        # lower (TPU's X64 rewriter has no u64 bitcast and demotes f64
+        # anyway); f64 grouping columns keep the host Arrow path there
+        import jax
+
+        if jax.default_backend() != "cpu":
+            return False
+    # headroom gate: the pass pins values+mask chunks in the cache
+    # (~9 B/row) AND allocates sort transients outside cache accounting
+    # (u64 keys + sorted copy + group keys + counts ~ 30 B/row, pow2
+    # padded); 64 B/row keeps the whole pass clear of HBM even when the
+    # budget is sized close to the device memory
+    return dataset.num_rows * 64 <= opts.device_cache_bytes
+
+
+def device_spill_frequencies(
+    dataset: Dataset, plan, engine
+) -> "DeviceFrequencies":
+    """One high-cardinality frequency pass fully on device."""
+    from deequ_tpu import config
+    from deequ_tpu.engine.scan import CHUNK_BATCHES
+    from deequ_tpu.sql.predicate import compile_predicate
+
+    column = plan.columns[0]
+    values_dtype = dataset.request_dtype(ColumnRequest(column, "values"))
+    if values_dtype.kind != "f":
+        key_kind = "int"
+    elif np.dtype(values_dtype).itemsize == 8:
+        key_kind = "f64"
+    else:
+        key_kind = "f32"
+    requests = [
+        ColumnRequest(column, "values"),
+        ColumnRequest(column, "mask"),
+    ]
+    pred = None
+    if plan.where is not None:
+        pred = compile_predicate(plan.where, dataset)
+        requests += list(pred.requests)
+
+    batch_size = engine._resolve_batch_size(dataset.num_rows)
+    nb = dataset.num_batches(batch_size)
+    chunk_batches = min(CHUNK_BATCHES, nb)
+    key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
+
+    keys_parts = []
+    n_sentinel = jnp.int64(0)
+    n_null = jnp.int64(0)
+    for chunk in dataset.device_scan_chunks(
+        requests,
+        batch_size,
+        chunk_batches=chunk_batches,
+        budget_bytes=config.options().device_cache_bytes,
+    ):
+        rows = chunk[ROW_MASK]
+        if pred is not None:
+            flat = {k: v.reshape(-1) for k, v in chunk.items()}
+            rows = rows & pred.complies(flat).reshape(rows.shape)
+        k, ns, nn = key_fn(
+            chunk[f"{column}::values"], chunk[f"{column}::mask"], rows
+        )
+        keys_parts.append(k)
+        n_sentinel = n_sentinel + ns
+        n_null = n_null + nn
+
+    keys = (
+        jnp.concatenate(keys_parts) if len(keys_parts) > 1 else keys_parts[0]
+    )
+    # pad to pow2 so the (expensive-to-compile) sort program is shared
+    # across datasets whose row counts round the same way
+    n = keys.shape[0]
+    padded = 1 << max(1, int(n - 1).bit_length()) if n > 1 else 1
+    if padded != n:
+        keys = jnp.concatenate(
+            [keys, jnp.full(padded - n, _SENTINEL, dtype=keys.dtype)]
+        )
+        n_sentinel = n_sentinel + (padded - n)
+
+    scalars, group_keys, counts = _finalize_fn()(keys, n_sentinel)
+    from deequ_tpu.engine.pack import packed_device_get
+
+    fetched = packed_device_get((scalars, n_null))
+    scalars, n_null_host = fetched
+    return DeviceFrequencies(
+        plan.columns,
+        values_dtype,
+        scalars,
+        group_keys,
+        counts,
+        int(n_null_host),
+        bool(plan.include_nulls),
+    )

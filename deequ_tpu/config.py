@@ -56,6 +56,9 @@ class Options:
     dense_grouping_budget_bytes: int = int(
         os.environ.get("DEEQU_TPU_DENSE_GROUPING_BYTES", 1 << 30)
     )
+    # device sort+segment path for high-cardinality single-numeric-column
+    # grouping (analyzers/spill.py); False forces the host Arrow fallback
+    device_spill_grouping: bool = True
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

@@ -31,9 +31,12 @@ class PassTiming:
 
 @dataclass
 class RunMetadata:
-    """Timings for one AnalysisRunner run."""
+    """Timings for one AnalysisRunner run, plus notable engine events
+    (e.g. grouping plans spilling out of the dense device path — a user
+    must be able to SEE why a high-card pass got slower)."""
 
     passes: List[PassTiming] = field(default_factory=list)
+    events: List[dict] = field(default_factory=list)
 
     @property
     def total_wall_s(self) -> float:
@@ -48,8 +51,10 @@ class RunMetadata:
         """Always a FRESH instance — never alias a mutable passes list
         between contexts."""
         if other is None:
-            return RunMetadata(list(self.passes))
-        return RunMetadata(self.passes + other.passes)
+            return RunMetadata(list(self.passes), list(self.events))
+        return RunMetadata(
+            self.passes + other.passes, self.events + other.events
+        )
 
     @staticmethod
     def merge_optional(

@@ -1,0 +1,159 @@
+"""Device sort+segment grouping (analyzers/spill.py): the TPU-native
+replacement for the host Arrow spill on high-cardinality single numeric
+columns. The ground truth is the host path itself (device_spill_grouping
+= False forces it), mirroring the reference's exact groupBy semantics."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.data import Dataset
+
+
+def _metrics(dataset, analyzers, spill: bool):
+    with config.configure(device_spill_grouping=spill):
+        ctx = AnalysisRunner.do_analysis_run(dataset, analyzers)
+    return {a: ctx.metric(a) for a in analyzers}
+
+
+def _assert_paths_agree(dataset, analyzers):
+    device = _metrics(dataset, analyzers, spill=True)
+    host = _metrics(dataset, analyzers, spill=False)
+    for a in analyzers:
+        d, h = device[a].value, host[a].value
+        assert d.is_success and h.is_success, (a, d, h)
+        dv, hv = d.get(), h.get()
+        if isinstance(dv, float):
+            assert dv == pytest.approx(hv, rel=1e-9), a
+        else:
+            assert dv == hv, a
+
+
+class TestDeviceSpillAgainstHost:
+    def test_int_column_all_count_metrics(self):
+        rng = np.random.default_rng(11)
+        ids = rng.integers(0, 5_000, 20_000, dtype=np.int64)
+        ids[::97] = np.iinfo(np.int64).max  # extreme values are legal keys
+        ids[::101] = np.iinfo(np.int64).min
+        ds = Dataset.from_pydict({"id": list(ids)})
+        _assert_paths_agree(
+            ds,
+            [
+                CountDistinct("id"),
+                Uniqueness("id"),
+                Distinctness("id"),
+                UniqueValueRatio("id"),
+                Entropy("id"),
+            ],
+        )
+
+    def test_float_column_with_nulls_nan_negzero(self):
+        vals = [1.5, -0.0, 0.0, float("nan"), float("nan"), None, 2.5, 1.5]
+        ds = Dataset.from_pydict({"x": vals * 100})
+        # host dictionary_encode groups NaN==NaN but keeps -0.0 and 0.0
+        # distinct; the device path canonicalizes NaN bits to match
+        _assert_paths_agree(
+            ds, [CountDistinct("x"), Uniqueness("x"), Distinctness("x")]
+        )
+
+    def test_where_filter(self):
+        rng = np.random.default_rng(5)
+        ds = Dataset.from_pydict(
+            {
+                "id": list(rng.integers(0, 500, 4_000, dtype=np.int64)),
+                "flag": list(rng.integers(0, 2, 4_000, dtype=np.int64)),
+            }
+        )
+        _assert_paths_agree(
+            ds,
+            [
+                CountDistinct("id", where="flag = 1"),
+                Uniqueness("id", where="flag = 1"),
+            ],
+        )
+
+    def test_histogram_includes_null_bin_and_topk(self):
+        rng = np.random.default_rng(7)
+        vals = rng.integers(0, 50, 5_000).astype(object)
+        vals[::13] = None
+        ds = Dataset.from_pydict({"v": list(vals)})
+        device = _metrics(ds, [Histogram("v", max_detail_bins=10)], True)
+        host = _metrics(ds, [Histogram("v", max_detail_bins=10)], False)
+        d = device[Histogram("v", max_detail_bins=10)].value.get()
+        h = host[Histogram("v", max_detail_bins=10)].value.get()
+        assert d.number_of_bins == h.number_of_bins
+        # top-10 bin COUNTS agree exactly (the k-th bin may tie-break to
+        # a different equally-frequent key); keys common to both agree
+        dd = {k: v.absolute for k, v in d.values.items()}
+        hh = {k: v.absolute for k, v in h.values.items()}
+        assert sorted(dd.values()) == sorted(hh.values())
+        for k in set(dd) & set(hh):
+            assert dd[k] == hh[k]
+
+    def test_float32_labels_match_dense_path(self):
+        import pyarrow as pa
+
+        vals = np.array([1.1, 2.2, 1.1, 3.3] * 50, dtype=np.float32)
+        ds = Dataset.from_arrow(pa.table({"x": pa.array(vals)}))
+        h = Histogram("x")
+        device = _metrics(ds, [h], True)[h].value.get()
+        host = _metrics(ds, [h], False)[h].value.get()
+        # keys decode in the column's OWN dtype: str(np.float32(1.1))
+        # == "1.1", not the widened float64 repr "1.100000023841858"
+        assert set(device.values) == set(host.values)
+        assert {k: v.absolute for k, v in device.values.items()} == {
+            k: v.absolute for k, v in host.values.items()
+        }
+
+    def test_empty_and_all_null(self):
+        ds = Dataset.from_pydict({"x": [None, None, None]})
+        with config.configure(device_spill_grouping=True):
+            ctx = AnalysisRunner.do_analysis_run(ds, [CountDistinct("x")])
+        # all rows null -> empty state -> failure metric, like the host path
+        assert not ctx.metric(CountDistinct("x")).value.is_success
+
+
+class TestSpillStateInterop:
+    def test_device_state_merges_with_host_state(self):
+        from deequ_tpu.analyzers.grouping import (
+            FrequenciesAndNumRows,
+            FrequencyPlan,
+            compute_many_frequencies,
+        )
+
+        a = Dataset.from_pydict({"id": [1, 2, 2, 3]})
+        b = Dataset.from_pydict({"id": [3, 4, 4, 5]})
+        plan = FrequencyPlan(("id",), None, False)
+        with config.configure(device_spill_grouping=True):
+            fa = compute_many_frequencies(a, [plan])[plan]
+        with config.configure(device_spill_grouping=False):
+            fb = compute_many_frequencies(b, [plan])[plan]
+        merged = FrequenciesAndNumRows.merge(fa, fb)
+        assert merged.num_rows == 8
+        assert merged.num_groups == 5
+        got = {
+            k: c for k, c in zip(merged.keys[:, 0], merged.counts)
+        }
+        assert got == {1: 1, 2: 2, 3: 2, 4: 2, 5: 1}
+
+    def test_spill_event_recorded_in_run_metadata(self):
+        rng = np.random.default_rng(3)
+        ds = Dataset.from_pydict(
+            {"id": list(rng.integers(0, 100, 1_000, dtype=np.int64))}
+        )
+        with config.configure(device_spill_grouping=True):
+            ctx = AnalysisRunner.do_analysis_run(ds, [Uniqueness("id")])
+        events = ctx.run_metadata.events
+        assert any(
+            e["event"] == "grouping_spill" and e["path"] == "device-sort"
+            for e in events
+        )
