@@ -247,9 +247,23 @@ def compute_many_frequencies(
         # dictionary at all — no host-side distinct set is built for a
         # high-cardinality numeric key column
         if spill_mod.device_spill_eligible(dataset, plan, engine):
-            results[plan] = spill_mod.device_spill_frequencies(
-                dataset, plan, engine
-            )
+            try:
+                results[plan] = spill_mod.device_spill_frequencies(
+                    dataset, plan, engine
+                )
+            except spill_mod.SpillOverflow:
+                # a sharded hash bucket exceeded its static capacity —
+                # exactness wins: take the host path instead
+                results[plan] = _arrow_frequencies(dataset, plan)
+                if events is not None:
+                    events.append(
+                        {
+                            "event": "grouping_spill",
+                            "columns": list(plan.columns),
+                            "path": "host-arrow-overflow",
+                        }
+                    )
+                continue
             if events is not None:
                 events.append(
                     {
@@ -444,25 +458,28 @@ def _decode_dense(
     )
 
 
+class FrequencyScanAdapter:
+    """Adapter so frequency passes ride the shared scan engine (and the
+    explicit shard_map step — see __graft_entry__): a fixed request
+    list standing in for an analyzer's device_requests."""
+
+    def __init__(self, requests):
+        self._requests = requests
+
+    def device_requests(self, ds):
+        return self._requests
+
+
 def _device_frequencies_shared(
     dataset: Dataset,
     dense: List[Tuple[FrequencyPlan, List[np.ndarray], List[int]]],
     engine: AnalysisEngine,
     count_dtype=np.int64,
 ) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
-    class _FreqAnalyzer:
-        """Adapter so frequency passes ride the shared scan engine."""
-
-        def __init__(self, requests):
-            self._requests = requests
-
-        def device_requests(self, ds):
-            return self._requests
-
     planned = []
     for plan, dictionaries, sizes in dense:
         requests, ops = _make_dense_ops(dataset, plan, sizes, count_dtype)
-        planned.append((_FreqAnalyzer(requests), ops))
+        planned.append((FrequencyScanAdapter(requests), ops))
     states = engine.run_scan(dataset, planned)  # type: ignore[arg-type]
     out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
     for (plan, dictionaries, sizes), (counts, num_rows) in zip(dense, states):

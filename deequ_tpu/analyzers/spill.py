@@ -103,51 +103,65 @@ def _chunk_key_fn(key_kind: str, include_nulls: bool):
     return jax.jit(build)
 
 
+def _segment_count(keys, correction):
+    """Traced: sort flat u64 keys, count segment boundaries, subtract
+    ``correction`` sentinel-valued entries from the trailing segment.
+    This is the ONE copy of the exactness-critical bookkeeping — both
+    the single-device finalize and the per-shard half of the sharded
+    shuffle run it. Output arrays have length N+1 (slot N absorbs
+    non-boundary scatter writes); segments occupy [0, num_segments)
+    and ``gmask`` marks those with a positive corrected count. Counts
+    are i32 (a chip processes < 2^31 rows per state; merges widen)."""
+    n = keys.shape[0]
+    k = jnp.sort(keys)  # ONE sort operand: see module docstring
+    boundary = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+    )
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_segments = seg[-1] + 1
+    counts = jnp.zeros(n + 1, dtype=jnp.int32).at[seg].add(1)
+    # sentinel-valued entries all sort to the end and share the last
+    # segment; the caller knows exactly how many don't belong
+    has_sentinel = k[-1] == _SENTINEL
+    counts = counts.at[seg[-1]].add(
+        -jnp.where(has_sentinel, correction, 0).astype(jnp.int32)
+    )
+    group_keys = (
+        jnp.zeros(n + 1, dtype=keys.dtype)
+        .at[jnp.where(boundary, seg, n)]
+        .set(k)
+    )
+    in_range = jnp.arange(n + 1, dtype=jnp.int32) < num_segments
+    gmask = in_range & (counts > 0)
+    return num_segments, counts, group_keys, gmask
+
+
+def _entropy_term(counts, gmask, total):
+    """Traced: -sum(p log p) over masked groups against a GLOBAL total
+    (partial term for psum in the sharded path; the whole sum in the
+    single-device path)."""
+    c = jnp.where(gmask, counts, 0).astype(jnp.float64)
+    tot_f = jnp.maximum(total, 1).astype(jnp.float64)
+    p = c / tot_f
+    return -jnp.sum(jnp.where(c > 0, p * jnp.log(p), 0.0))
+
+
 @functools.lru_cache(maxsize=None)
 def _finalize_fn():
     """Jitted: flat u64 keys + sentinel count -> per-group arrays and
-    scalars. Output arrays have length N+1 (slot N absorbs non-boundary
-    scatter writes); value groups occupy slots [0, num_segments) with
-    the sentinel-sharing segment's count corrected (possibly to 0).
-    Counts are i32 (a chip processes < 2^31 rows per state; cross-state
-    merges widen)."""
+    scalars (single-device path)."""
 
     def run(keys, n_sentinel):
-        n = keys.shape[0]
-        k = jnp.sort(keys)  # ONE sort operand: see module docstring
-        boundary = jnp.concatenate(
-            [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+        num_segments, counts, group_keys, gmask = _segment_count(
+            keys, n_sentinel
         )
-        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        num_segments = seg[-1] + 1
-        counts = jnp.zeros(n + 1, dtype=jnp.int32).at[seg].add(1)
-        # sentinel correction: all non-contributing rows sorted to the
-        # end and share the last segment with any legit int64.max rows
-        has_sentinel = k[-1] == _SENTINEL
-        counts = counts.at[seg[-1]].add(
-            -jnp.where(has_sentinel, n_sentinel, 0).astype(jnp.int32)
-        )
-        group_keys = (
-            jnp.zeros(n + 1, dtype=keys.dtype)
-            .at[jnp.where(boundary, seg, n)]
-            .set(k)
-        )
-        in_range = jnp.arange(n + 1, dtype=jnp.int32) < num_segments
-        gmask = in_range & (counts > 0)
-        num_groups = jnp.sum(gmask, dtype=jnp.int64)
-        total = (n - n_sentinel).astype(jnp.int64)
-        unique = jnp.sum((counts == 1) & gmask, dtype=jnp.int64)
-        # entropy over value groups (all non-null by construction)
-        c = jnp.where(gmask, counts, 0).astype(jnp.float64)
-        tot_f = jnp.maximum(total, 1).astype(jnp.float64)
-        p = c / tot_f
-        entropy = -jnp.sum(jnp.where(c > 0, p * jnp.log(p), 0.0))
+        total = (keys.shape[0] - n_sentinel).astype(jnp.int64)
         scalars = {
             "num_segments": num_segments.astype(jnp.int64),
-            "num_groups": num_groups,
+            "num_groups": jnp.sum(gmask, dtype=jnp.int64),
             "total": total,
-            "unique": unique,
-            "entropy": entropy,
+            "unique": jnp.sum((counts == 1) & gmask, dtype=jnp.int64),
+            "entropy": _entropy_term(counts, gmask, total),
         }
         return scalars, group_keys, counts
 
@@ -161,6 +175,179 @@ def _topk_fn(counts, group_keys, num_segments, k):
     )
     tc, ti = jax.lax.top_k(jnp.where(in_range, counts, -1), k)
     return tc, jnp.take(group_keys, ti)
+
+
+def _pack_top_pairs(pairs, k: int, null_rows: int):
+    """Shared top-k tail: merge in the null bin (a host scalar) and
+    pack (keys, counts) arrays."""
+    if null_rows > 0:
+        pairs = list(pairs) + [(None, np.int64(null_rows))]
+        pairs.sort(key=lambda kv: -kv[1])
+        pairs = pairs[:k]
+    if not pairs:
+        return np.zeros(0, dtype=object), np.zeros(0, dtype=np.int64)
+    keys_out = np.empty(len(pairs), dtype=object)
+    keys_out[:] = [p[0] for p in pairs]
+    return keys_out, np.asarray([p[1] for p in pairs], dtype=np.int64)
+
+
+class SpillOverflow(Exception):
+    """A sharded spill bucket exceeded its static capacity; the caller
+    falls back to the host Arrow path (exactness over speed)."""
+
+
+def _fmix64(x):
+    """murmur3 64-bit finalizer: avalanches sequential ids into uniform
+    bucket assignments (a plain ``key % ndev`` would send stride-ndev
+    id ranges all to one shard)."""
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(0xFF51AFD7ED558CCD)
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> np.uint64(33))
+    return x
+
+
+def _fmix64_int(x: int) -> int:
+    """Host-side _fmix64 over Python ints (no numpy overflow warnings);
+    used for trace-time constants like the sentinel's bucket."""
+    m = (1 << 64) - 1
+    x &= m
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & m
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & m
+    x ^= x >> 33
+    return x
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_spill_fn(mesh, axis: str, cap: int):
+    """Jitted shard_map: the TPU shuffle (SURVEY.md §2.6, §7 hard part
+    #1). Each shard hash-buckets its local u64 keys, ``all_to_all``
+    re-shards them so EQUAL keys land on the same device, then each
+    device runs the SAME sort + segment-count as the single-device path
+    (_segment_count) over its disjoint key range; scalars psum into
+    global metrics. Per-device memory is O(rows/ndev): group arrays
+    come back SHARDED (out_specs P(axis)), never replicated.
+
+    Sentinel-valued rows (dropped rows AND any legit int64.max keys —
+    indistinguishable by value) never enter the shuffle at all: their
+    global count minus the known dropped count is exactly the
+    int64.max group's count, reconstructed analytically. The only
+    sentinel-valued entries a shard receives are therefore all_to_all
+    PADDING, whose count derives from the communicated per-bucket
+    counts. A bucket overflow (static ``cap`` exceeded) is reported as
+    a scalar; the host falls back to the Arrow path rather than
+    dropping rows."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ndev = mesh.shape[axis]
+
+    def per_shard(keys, n_sentinel_global, n_null_global):
+        m = keys.shape[0]
+        is_sent = keys == _SENTINEL
+        sv_local = jnp.sum(is_sent, dtype=jnp.int64)
+        bucket = (_fmix64(keys) % np.uint64(ndev)).astype(jnp.int32)
+        # sentinel-valued rows are excluded from the shuffle (their
+        # count is bookkept in scalars); bucket ndev scatters to drop
+        bucket = jnp.where(is_sent, ndev, bucket)
+        order = jnp.argsort(bucket, stable=True)
+        sorted_keys = keys[order]
+        sorted_bucket = bucket[order]
+        bcounts = (
+            jnp.zeros(ndev, jnp.int32).at[bucket].add(1, mode="drop")
+        )
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(bcounts)[:-1]]
+        )
+        pos = jnp.arange(m, dtype=jnp.int32) - offsets[
+            jnp.clip(sorted_bucket, 0, ndev - 1)
+        ]
+        in_cap = (pos < cap) & (sorted_bucket < ndev)
+        send = (
+            jnp.full((ndev, cap), _SENTINEL, dtype=keys.dtype)
+            .at[
+                jnp.where(in_cap, sorted_bucket, ndev),
+                jnp.clip(pos, 0, cap - 1),
+            ]
+            .set(sorted_keys, mode="drop")
+        )
+        overflow = jax.lax.psum(
+            jnp.sum(jnp.maximum(bcounts - cap, 0)), axis
+        )
+
+        recv = jax.lax.all_to_all(
+            send, axis, split_axis=0, concat_axis=0
+        ).ravel()  # (ndev*cap,)
+        # real (non-padding) entry counts per (sender, my bucket)
+        sent_real = jnp.minimum(bcounts, cap)  # (ndev,) what I sent
+        recv_real = jax.lax.all_to_all(
+            sent_real[:, None], axis, split_axis=0, concat_axis=0
+        )  # (ndev, 1): shard s's real count for MY bucket
+        padding_received = ndev * cap - jnp.sum(recv_real)
+
+        # the shared exactness-critical bookkeeping (spill.py's one copy)
+        num_segments, counts, group_keys, gmask = _segment_count(
+            recv, padding_received.astype(jnp.int64)
+        )
+
+        # the analytic int64.max group: sentinel-VALUED rows globally,
+        # minus the known dropped-row count
+        legit_max = (
+            jax.lax.psum(sv_local, axis) - n_sentinel_global
+        )
+        local_total = jnp.sum(
+            jnp.where(gmask, counts, 0), dtype=jnp.int64
+        )
+        total = jax.lax.psum(local_total, axis) + legit_max
+        num_groups = (
+            jax.lax.psum(jnp.sum(gmask, dtype=jnp.int64), axis)
+            + (legit_max > 0).astype(jnp.int64)
+        )
+        unique = (
+            jax.lax.psum(
+                jnp.sum((counts == 1) & gmask, dtype=jnp.int64), axis
+            )
+            + (legit_max == 1).astype(jnp.int64)
+        )
+        pm = legit_max.astype(jnp.float64) / jnp.maximum(
+            total, 1
+        ).astype(jnp.float64)
+        entropy = jax.lax.psum(
+            _entropy_term(counts, gmask, total), axis
+        ) + jnp.where(legit_max > 0, -pm * jnp.log(jnp.maximum(pm, 1e-300)), 0.0)
+        scalars = {
+            # replicated upper bound; per-shard true values ride the
+            # sharded num_segments vector (sliced at fetch time)
+            "num_segments": jax.lax.pmax(
+                num_segments, axis
+            ).astype(jnp.int64),
+            "num_groups": num_groups,
+            "total": total,
+            "unique": unique,
+            "entropy": entropy,
+            "legit_max": legit_max,
+        }
+        return (
+            scalars,
+            group_keys,  # sharded out: (ndev*(L+1),) global
+            counts,
+            num_segments.astype(jnp.int32)[None],  # (ndev,) global
+            overflow,
+            n_null_global,
+        )
+
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=(P(), P(axis), P(axis), P(axis), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
 
 
 class DeviceFrequencies(FrequenciesAndNumRows):
@@ -191,6 +378,8 @@ class DeviceFrequencies(FrequenciesAndNumRows):
         self._null_rows = int(null_rows) if include_nulls else 0
         self._include_nulls = include_nulls
         self.num_rows = int(scalars["total"]) + self._null_rows
+        # sharded path only: analytically-reconstructed int64.max group
+        self._legit_max = int(scalars.get("legit_max", 0))
         self._dev = (group_keys, counts)
         self._keys_host: Optional[np.ndarray] = None
         self._counts_host: Optional[np.ndarray] = None
@@ -286,15 +475,58 @@ class DeviceFrequencies(FrequenciesAndNumRows):
             live = tc > 0  # zeroed sentinel segment never bins
             decoded = self._decode_keys(np.asarray(tkeys)[live])
             pairs = list(zip(decoded, tc[live].astype(np.int64)))
-        if self._has_null_group:
-            pairs.append((None, np.int64(self._null_rows)))
-            pairs.sort(key=lambda kv: -kv[1])
-            pairs = pairs[:k]
-        if not pairs:
-            return np.zeros(0, dtype=object), np.zeros(0, dtype=np.int64)
-        keys_out = np.empty(len(pairs), dtype=object)
-        keys_out[:] = [p[0] for p in pairs]
-        return keys_out, np.asarray([p[1] for p in pairs], dtype=np.int64)
+        return _pack_top_pairs(
+            pairs, k, self._null_rows if self._has_null_group else 0
+        )
+
+
+class ShardedDeviceFrequencies(DeviceFrequencies):
+    """DeviceFrequencies whose groups live SHARDED across a mesh: each
+    device holds the (keys, counts, num_segments) of its disjoint hash
+    range (nothing is replicated); fetching is a filtered concatenation
+    plus the analytically-reconstructed int64.max group, if any."""
+
+    def _fetch(self) -> None:
+        if self._counts_host is None:
+            gk_flat, gc_flat, segs = (
+                np.asarray(x) for x in self._dev
+            )
+            ndev = len(segs)
+            gk = gk_flat.reshape(ndev, -1)
+            gc = gc_flat.reshape(ndev, -1)
+            keys_parts, count_parts = [], []
+            for shard in range(ndev):
+                s = int(segs[shard])
+                raw_k = gk[shard][:s]
+                raw_c = gc[shard][:s]
+                live = raw_c > 0
+                keys_parts.append(raw_k[live])
+                count_parts.append(raw_c[live])
+            if self._legit_max > 0:
+                keys_parts.append(np.array([_SENTINEL], dtype=np.uint64))
+                count_parts.append(
+                    np.array([self._legit_max], dtype=np.int64)
+                )
+            self._keys_host = np.concatenate(keys_parts)
+            self._counts_host = np.concatenate(count_parts).astype(
+                np.int64
+            )
+
+    def top_groups(self, k: int):
+        # host-side top-k over the fetched union (a per-shard device
+        # top_k + gather would cut the fetch further; at histogram's
+        # k<=1000 the union fetch is the simpler exact path)
+        self._fetch()
+        order = np.argsort(-self._counts_host, kind="stable")[:k]
+        pairs = list(
+            zip(
+                self._decode_keys(self._keys_host[order]),
+                self._counts_host[order],
+            )
+        )
+        return _pack_top_pairs(
+            pairs, k, self._null_rows if self._has_null_group else 0
+        )
 
 
 def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
@@ -316,8 +548,6 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
         return False
     if not opts.device_cache_bytes:
         return False  # chunked device path needs the resident cache
-    if engine is not None and engine.mesh is not None:
-        return False  # sharded sort needs an all_to_all re-shard (TODO)
     if opts.engine == "cpu":
         return False  # honor the engine-selection flag's placement
     if dataset.num_rows >= 2**31:
@@ -353,7 +583,8 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
 def device_spill_frequencies(
     dataset: Dataset, plan, engine
 ) -> "DeviceFrequencies":
-    """One high-cardinality frequency pass fully on device."""
+    """One high-cardinality frequency pass fully on device (sharded
+    across the engine's mesh when one is set)."""
     from deequ_tpu import config
     from deequ_tpu.engine.scan import CHUNK_BATCHES
     from deequ_tpu.sql.predicate import compile_predicate
@@ -374,6 +605,11 @@ def device_spill_frequencies(
     if plan.where is not None:
         pred = compile_predicate(plan.where, dataset)
         requests += list(pred.requests)
+
+    if engine is not None and getattr(engine, "mesh", None) is not None:
+        return _sharded_spill_frequencies(
+            dataset, plan, engine, column, values_dtype, key_kind, pred
+        )
 
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
@@ -427,3 +663,89 @@ def device_spill_frequencies(
         int(n_null_host),
         bool(plan.include_nulls),
     )
+
+
+def _sharded_spill_frequencies(
+    dataset: Dataset,
+    plan,
+    engine,
+    column: str,
+    values_dtype: np.dtype,
+    key_kind: str,
+    pred,
+) -> "ShardedDeviceFrequencies":
+    """Mesh variant: build the global u64 key vector (row-sharded over
+    the dp axis), then run the hash-bucket all_to_all re-shard + local
+    sort (see _sharded_spill_fn). Raises SpillOverflow when a bucket
+    exceeds its static capacity; the caller falls back to Arrow."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deequ_tpu.engine.pack import packed_device_get
+
+    mesh, axis = engine.mesh, engine.dp_axis
+    ndev = mesh.shape[axis]
+    n = dataset.num_rows
+    # pow2 padding (rounded to a mesh multiple): the per-shard sort's
+    # expensive-to-compile program is then shared across datasets whose
+    # row counts round the same way, exactly like the single-device path
+    pow2 = 1 << max(1, int(max(n, 1) - 1).bit_length())
+    padded = max(1, -(-pow2 // ndev)) * ndev
+    sharding = NamedSharding(mesh, P(axis))
+
+    def pad_to(host: np.ndarray) -> np.ndarray:
+        if len(host) < padded:
+            host = np.concatenate(
+                [host, np.zeros(padded - len(host), dtype=host.dtype)]
+            )
+        return host
+
+    flat = {}
+    needed = {ColumnRequest(column, "values"), ColumnRequest(column, "mask")}
+    if pred is not None:
+        needed.update(pred.requests)
+    for r in needed:
+        flat[r.key] = jax.device_put(
+            pad_to(dataset.materialize(r)), sharding
+        )
+    rows_host = np.zeros(padded, dtype=bool)
+    rows_host[:n] = True
+    flat[ROW_MASK] = jax.device_put(rows_host, sharding)
+
+    key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
+
+    def build(batch):
+        rows = batch[ROW_MASK]
+        if pred is not None:
+            rows = rows & pred.complies(batch)
+        return key_fn(
+            batch[f"{column}::values"], batch[f"{column}::mask"], rows
+        )
+
+    keys, n_sentinel, n_null = jax.jit(build)(flat)
+
+    m_local = padded // ndev
+    # pow2 capacity (shared compiles); 4x the uniform expectation is
+    # comfortable headroom for hashed buckets — dropped rows never
+    # enter the shuffle, so nulls/filters cannot skew a bucket
+    cap = 1 << max(8, ((4 * m_local) // ndev - 1).bit_length())
+    out = _sharded_spill_fn(mesh, axis, cap)(keys, n_sentinel, n_null)
+    scalars, g_keys, g_counts, g_segs, overflow, n_null_global = out
+    scalars, overflow_host, n_null_host, segs_host = packed_device_get(
+        (scalars, overflow, n_null_global, np.asarray(g_segs))
+    )
+    if int(overflow_host) > 0:
+        raise SpillOverflow(
+            f"hash bucket exceeded capacity {cap} on column {column!r}"
+        )
+    state = ShardedDeviceFrequencies(
+        plan.columns,
+        values_dtype,
+        scalars,
+        g_keys,
+        g_counts,
+        int(n_null_host),
+        bool(plan.include_nulls),
+    )
+    state._dev = (g_keys, g_counts, segs_host)
+    return state

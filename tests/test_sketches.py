@@ -228,13 +228,35 @@ class TestKLLRegressions:
         assert metric.value.is_success, metric.value
         assert metric.value.get() == 999.0
 
-    def test_sharded_step_rejects_host_fold(self, cpu_mesh):
+    def test_sharded_step_host_folds_kll(self, cpu_mesh):
+        """Host-folded ops ride the explicit shard_map step: each
+        shard's per-batch output is all_gathered and folded once on the
+        host (the fold IS the sketch merge, so sharding can't change
+        the metric on data small enough to stay uncompacted)."""
+        import jax
+
         from deequ_tpu.engine import AnalysisEngine
 
-        ds = Dataset.from_pydict({"x": [1.0, 2.0]})
+        # small enough that BOTH paths keep every value at level 0
+        # (nv < sketch_size), so sharded and single are exactly equal
+        n = 8 * 128
+        vals = np.arange(float(n))
+        ds = Dataset.from_pydict({"x": list(vals)})
         analyzer = ApproxQuantile("x", 0.5)
         planned = [(analyzer, analyzer.make_ops(ds))]
-        with pytest.raises(ValueError, match="host-folded"):
-            AnalysisEngine(mesh=cpu_mesh).build_sharded_step(
-                ds, planned, cpu_mesh
-            )
+        engine = AnalysisEngine(mesh=cpu_mesh)
+        step = engine.build_sharded_step(ds, planned, cpu_mesh)
+        requests = [
+            r for a, _ in planned for r in a.device_requests(ds)
+        ]
+        (batch,) = list(ds.device_batches(requests, n))
+        states = tuple(op.init() for _, op in planned)
+        out = jax.block_until_ready(step(states, batch))
+        (final,) = engine.fold_sharded_host_outputs(
+            [op for _, op in planned], out, 8
+        )
+        got = analyzer.compute_metric_from_state(final).value.get()
+        want = AnalysisRunner.do_analysis_run(ds, [analyzer]).metric(
+            analyzer
+        ).value.get()
+        assert got == pytest.approx(want, rel=1e-9)

@@ -145,6 +145,40 @@ class TestSpillStateInterop:
         }
         assert got == {1: 1, 2: 2, 3: 2, 4: 2, 5: 1}
 
+    def test_sharded_spill_equals_single_device(self, cpu_mesh):
+        """The hash-bucket all_to_all re-shard (SURVEY §7 hard part #1):
+        a high-cardinality int column under an 8-device mesh must give
+        exactly the single-device answer."""
+        from deequ_tpu.engine import AnalysisEngine
+
+        rng = np.random.default_rng(21)
+        ids = rng.integers(0, 40_000, 64_000, dtype=np.int64)
+        ids[::513] = np.iinfo(np.int64).max  # exercises the sentinel path
+        vals = ids.astype(object)
+        vals[::97] = None
+        ds = Dataset.from_pydict({"id": list(vals)})
+        analyzers = [
+            CountDistinct("id"),
+            Uniqueness("id"),
+            Distinctness("id"),
+            Entropy("id"),
+            Histogram("id", max_detail_bins=20),
+        ]
+        single = AnalysisRunner.do_analysis_run(ds, analyzers)
+        meshed = AnalysisRunner.do_analysis_run(
+            ds, analyzers, engine=AnalysisEngine(mesh=cpu_mesh)
+        )
+        for a in analyzers[:4]:
+            assert meshed.metric(a).value.get() == pytest.approx(
+                single.metric(a).value.get(), rel=1e-9
+            ), a
+        hs = single.metric(analyzers[4]).value.get()
+        hm = meshed.metric(analyzers[4]).value.get()
+        assert hs.number_of_bins == hm.number_of_bins
+        assert sorted(
+            v.absolute for v in hs.values.values()
+        ) == sorted(v.absolute for v in hm.values.values())
+
     def test_spill_event_recorded_in_run_metadata(self):
         rng = np.random.default_rng(3)
         ds = Dataset.from_pydict(
