@@ -238,6 +238,117 @@ def bench_sketches(num_rows: int):
     }
 
 
+def bench_profiler_wide(num_rows: int, num_cols: int):
+    """Compile-scaling config: a 50-col profile lowers ~300 analyzers;
+    cold_s is the number to watch (the north-star table IS 50 cols)."""
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    warm = _tpcds_like(num_rows, num_cols, seed=3)
+    cold_s, _, _, _ = _timed(lambda: ColumnProfiler.profile(warm))
+    fresh = _tpcds_like(num_rows, num_cols, seed=4)
+    wall, shipped, mbps, _ = _timed(lambda: ColumnProfiler.profile(fresh))
+    return {
+        "wall_s": wall,
+        "cold_s": cold_s,
+        "rows_per_sec": num_rows / wall,
+        "bytes_shipped": shipped,
+        "link_mb_per_sec": mbps,
+    }
+
+
+def bench_spill_grouping(num_rows: int):
+    """High-cardinality exact grouping (~num_rows distinct int64 keys):
+    the device sort+segment path vs the host Arrow group_by, fresh and
+    device-resident."""
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        CountDistinct,
+        Distinctness,
+        Uniqueness,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        import pyarrow as pa
+
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {"id": rng.integers(0, 1 << 40, num_rows, dtype=np.int64)}
+            )
+        )
+
+    analyzers = [CountDistinct("id"), Uniqueness("id"), Distinctness("id")]
+    AnalysisRunner.do_analysis_run(make(5), analyzers)  # warm compile
+    fresh = make(6)
+    wall, shipped, mbps, ctx = _timed(
+        lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+    )
+    resident_wall, _, _, _ = _timed(
+        lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+    )
+    with config.configure(device_spill_grouping=False):
+        host_ds = make(6)
+        arrow_wall, _, _, _ = _timed(
+            lambda: AnalysisRunner.do_analysis_run(host_ds, analyzers)
+        )
+    spilled = [
+        e for e in (ctx.run_metadata.events if ctx.run_metadata else [])
+        if e.get("event") == "grouping_spill"
+    ]
+    return {
+        "wall_s": wall,
+        "rows_per_sec": num_rows / wall,
+        "bytes_shipped": shipped,
+        "link_mb_per_sec": mbps,
+        "resident_wall_s": resident_wall,
+        "resident_rows_per_sec": num_rows / resident_wall,
+        "host_arrow_wall_s": arrow_wall,
+        "device_vs_arrow_resident": arrow_wall / resident_wall,
+        "spill_events": spilled,
+    }
+
+
+def bench_streaming_parquet(num_rows: int, num_cols: int):
+    """Streaming ingest config: profile a multi-file parquet table with
+    the device cache disabled — memory stays O(batch), every byte
+    re-streams from storage through the packed-mask wire diet."""
+    import shutil
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import config
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_pq_")
+    try:
+        ds = _tpcds_like(num_rows, num_cols, seed=7)
+        shard_rows = num_rows // 4
+        for i in range(4):
+            # the last shard takes the remainder so every row lands
+            length = None if i == 3 else shard_rows
+            pq.write_table(
+                ds.table.slice(i * shard_rows, length),
+                f"{workdir}/part{i}.parquet",
+            )
+        with config.configure(device_cache_bytes=0, batch_size=1 << 19):
+            ColumnProfiler.profile(Dataset.from_parquet(workdir))  # warm
+            wall, shipped, mbps, _ = _timed(
+                lambda: ColumnProfiler.profile(Dataset.from_parquet(workdir))
+            )
+        return {
+            "wall_s": wall,
+            "rows_per_sec": num_rows / wall,
+            "bytes_shipped": shipped,
+            "link_mb_per_sec": mbps,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     # scaled to one chip: 4M rows x 20 cols for the headline profiler run
     prof_rows, prof_cols = 4_000_000, 20
@@ -247,6 +358,13 @@ def main():
         detail["fused_bundle_10col"] = bench_fused_bundle(8_000_000)
         detail["grouping_5cat"] = bench_grouping(4_000_000)
         detail["sketches_hll_kll"] = bench_sketches(8_000_000)
+        detail["profiler_50col"] = bench_profiler_wide(1_000_000, 50)
+        detail["spill_grouping_12M_distinct"] = bench_spill_grouping(
+            12_000_000
+        )
+        detail["streaming_parquet"] = bench_streaming_parquet(
+            4_000_000, 10
+        )
     except Exception as exc:  # secondary configs must not kill the line
         detail["error"] = repr(exc)
 

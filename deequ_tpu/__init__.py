@@ -93,6 +93,7 @@ from deequ_tpu.repository.base import (  # noqa: E402
     ResultKey,
 )
 from deequ_tpu.repository.fs import FileSystemMetricsRepository  # noqa: E402
+from deequ_tpu.repository.table import TableMetricsRepository  # noqa: E402
 from deequ_tpu.suggestions.rules import DEFAULT_RULES  # noqa: E402
 from deequ_tpu.suggestions.runner import (  # noqa: E402
     ConstraintSuggestionResult,
@@ -162,6 +163,7 @@ __all__ = [
     "Entity",
     "Entropy",
     "FileSystemMetricsRepository",
+    "TableMetricsRepository",
     "FileSystemStateProvider",
     "Histogram",
     "HistogramMetric",

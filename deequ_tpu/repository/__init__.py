@@ -6,10 +6,12 @@ from deequ_tpu.repository.base import (
     ResultKey,
 )
 from deequ_tpu.repository.fs import FileSystemMetricsRepository
+from deequ_tpu.repository.table import TableMetricsRepository
 
 __all__ = [
     "AnalysisResult",
     "FileSystemMetricsRepository",
+    "TableMetricsRepository",
     "InMemoryMetricsRepository",
     "MetricsRepository",
     "MetricsRepositoryMultipleResultsLoader",

@@ -1,0 +1,125 @@
+"""Table-backed metrics repository over a parquet dataset directory.
+
+Reference: ``repository/sparkTable/SparkTableMetricsRepository.scala``
+(SURVEY.md §2.5 ⚠ row) — the reference appends each AnalysisResult as a
+row of a Spark SQL table (result_key serialized alongside a JSON metric
+payload) so repositories can live in a warehouse, be appended
+concurrently, and be queried with predicate pushdown.
+
+The TPU-stack-native equivalent of "a Spark table" is an Arrow/parquet
+dataset directory: each ``save`` appends ONE small parquet file of one
+row (append = new file, the same contract as a warehouse table append —
+no read-modify-write, so concurrent writers from different hosts never
+conflict). ``load_by_key`` pushes a result_key equality filter into the
+Arrow dataset scan; ``load()`` deserializes everything and filters via
+the loader API in memory (dataset_date/tags are real columns, so
+external warehouse tools can predicate on them directly).
+
+Row schema (mirrors the reference's table layout):
+  result_key   : string (canonical JSON of timestamp + tags)
+  dataset_date : int64  (the ResultKey timestamp — filterable column)
+  tags         : string (JSON object)
+  seq          : int64  (monotonic write sequence — last write per key wins)
+  serialized_context : string (full AnalysisResult via repository.serde)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from deequ_tpu.repository import serde
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+
+_SCHEMA = pa.schema(
+    [
+        ("result_key", pa.string()),
+        ("dataset_date", pa.int64()),
+        ("tags", pa.string()),
+        ("seq", pa.int64()),
+        ("serialized_context", pa.string()),
+    ]
+)
+
+
+def _key_json(key: ResultKey) -> str:
+    return json.dumps(
+        {"dataset_date": key.dataset_date, "tags": key.tags_dict},
+        sort_keys=True,
+    )
+
+
+class TableMetricsRepository(MetricsRepository):
+    """Append-only parquet-table repository (one file per save)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(path, exist_ok=True)
+
+    def save(self, result: AnalysisResult) -> None:
+        key = result.result_key
+        table = pa.table(
+            {
+                "result_key": [_key_json(key)],
+                "dataset_date": [int(key.dataset_date)],
+                "tags": [json.dumps(key.tags_dict, sort_keys=True)],
+                "seq": [time.time_ns()],
+                "serialized_context": [serde.serialize([result])],
+            },
+            schema=_SCHEMA,
+        )
+        # unique filename: appends never clobber (multi-writer safe)
+        name = f"{key.dataset_date}-{uuid.uuid4().hex}.parquet"
+        pq.write_table(table, os.path.join(self._path, name))
+
+    def _scan(self, filter_expr=None) -> List[AnalysisResult]:
+        if not os.listdir(self._path):
+            return []
+        dataset = pads.dataset(self._path, format="parquet")
+        table = dataset.to_table(
+            columns=["result_key", "seq", "serialized_context"],
+            filter=filter_expr,
+        )
+        out: List[AnalysisResult] = []
+        seen: dict = {}
+        for key_json, seq, payload in zip(
+            table.column("result_key").to_pylist(),
+            table.column("seq").to_pylist(),
+            table.column("serialized_context").to_pylist(),
+        ):
+            # last write per key wins (the reference overwrites on
+            # save; an append-only table keeps history — dedupe at read
+            # by the monotonic write sequence, NOT file enumeration
+            # order, which is uuid-random)
+            prior = seen.get(key_json)
+            if prior is None or seq > prior[0]:
+                seen[key_json] = (seq, payload)
+        for _, payload in seen.values():
+            out.extend(serde.deserialize(payload))
+        # deterministic order regardless of file enumeration order
+        out.sort(key=lambda r: r.result_key.dataset_date)
+        return out
+
+    def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:
+        import pyarrow.compute as pc
+
+        wanted = _key_json(key)
+        for result in self._scan(pc.field("result_key") == wanted):
+            if result.result_key == key:
+                return result
+        return None
+
+    def load(self) -> MetricsRepositoryMultipleResultsLoader:
+        return MetricsRepositoryMultipleResultsLoader(self._scan())

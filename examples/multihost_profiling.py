@@ -1,0 +1,181 @@
+"""Multi-host execution over loopback: the SURVEY §7 stage-8 story.
+
+The reference scales by running one Spark executor per host; deequ_tpu
+scales the same workload shape with one JAX process per host
+(SURVEY.md §2.6, docs/MULTIHOST.md): every host profiles ITS OWN shard
+of the table, persists the resulting analyzer STATES (the mergeable
+monoids, not the metrics), and any process folds the states into
+whole-table metrics with ``run_on_aggregated_states`` — metric-exact,
+no row ever crosses hosts.
+
+This script EXECUTES that design with two real processes on this
+machine, each calling ``jax.distributed.initialize`` against a loopback
+coordinator (the same call a real pod uses with a head-node address):
+
+    python examples/multihost_profiling.py
+
+It writes a two-shard parquet table, spawns the two workers, waits for
+both, merges their persisted states, and asserts the merged metrics
+equal a single-process run over the whole table.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)  # run from a source checkout w/o installing
+
+
+ANALYZER_SRC = (
+    "[Size(), Mean('x'), StandardDeviation('x'), Completeness('x'), "
+    "CountDistinct('k'), Uniqueness('k'), ApproxCountDistinct('k')]"
+)
+
+WORKER = r"""
+import sys
+import jax
+
+coordinator, process_id, shard_path, state_dir = sys.argv[1:5]
+# order matters: platform + distributed BEFORE any backend init
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=coordinator,
+    num_processes=2,
+    process_id=int(process_id),
+)
+assert jax.process_count() == 2, jax.process_count()
+
+from deequ_tpu import Dataset, FileSystemStateProvider
+from deequ_tpu.analyzers import (
+    AnalysisRunner, ApproxCountDistinct, Completeness, CountDistinct,
+    Mean, Size, StandardDeviation, Uniqueness,
+)
+
+dataset = Dataset.from_parquet(shard_path)
+AnalysisRunner.do_analysis_run(
+    dataset,
+    ANALYZERS,
+    save_states_with=FileSystemStateProvider(state_dir),
+)
+print(f"worker {process_id}: states persisted", flush=True)
+""".replace("ANALYZERS", ANALYZER_SRC)
+
+
+def main() -> None:
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_multihost_")
+    try:
+        _run(workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _run(workdir: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(3)
+    n = 60_000
+    x = rng.normal(10.0, 2.0, n).astype(object)
+    x[::11] = None
+    k = rng.integers(0, 20_000, n, dtype=np.int64)
+    table = pa.table({"x": pa.array(list(x), pa.float64()), "k": k})
+
+    shards = []
+    for i in range(2):
+        path = os.path.join(workdir, f"shard{i}.parquet")
+        pq.write_table(table.slice(i * n // 2, n // 2), path)
+        shards.append(path)
+
+    with socket.socket() as s:  # free loopback port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    state_dirs = [os.path.join(workdir, f"states{i}") for i in range(2)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, coordinator, str(i),
+             shards[i], state_dirs[i]],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    # wait on BOTH with a shared deadline: when one worker dies, its
+    # sibling hangs in distributed collectives — kill it and report the
+    # real failure's output, not a timeout
+    import time as _time
+
+    deadline = _time.monotonic() + 300
+    outputs = [b"", b""]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outputs[i], _ = p.communicate(
+                    timeout=max(1.0, deadline - _time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                pass  # judged below after every worker is reaped
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for i, p in enumerate(procs):
+            if p.poll() is None or not outputs[i]:
+                try:
+                    extra, _ = p.communicate(timeout=10)
+                    outputs[i] = outputs[i] + (extra or b"")
+                except Exception:  # noqa: BLE001 — reporting only
+                    pass
+    failed = [i for i, p in enumerate(procs) if p.returncode != 0]
+    if failed:
+        report = "\n".join(
+            f"--- worker {i} (rc={procs[i].returncode}) ---\n"
+            + outputs[i].decode(errors="replace")
+            for i in range(2)
+        )
+        raise RuntimeError(f"worker(s) {failed} failed:\n{report}")
+
+    # any process (here: this one) folds the persisted per-host states
+    from deequ_tpu import Dataset, FileSystemStateProvider
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        ApproxCountDistinct,
+        Completeness,
+        CountDistinct,
+        Mean,
+        Size,
+        StandardDeviation,
+        Uniqueness,
+    )
+
+    analyzers = eval(ANALYZER_SRC)  # same set the workers ran
+    whole = Dataset.from_arrow(table)
+    merged = AnalysisRunner.run_on_aggregated_states(
+        whole.schema,
+        analyzers,
+        [FileSystemStateProvider(d) for d in state_dirs],
+    )
+    single = AnalysisRunner.do_analysis_run(whole, analyzers)
+    for a in analyzers:
+        got = merged.metric(a).value.get()
+        want = single.metric(a).value.get()
+        assert abs(got - want) <= 1e-9 * max(1.0, abs(want)), (
+            a, got, want,
+        )
+        print(f"{a.name:>22}: merged {got:.6f} == single {want:.6f}")
+    print("multi-host (2 processes, loopback): merged == whole-table")
+
+
+if __name__ == "__main__":
+    main()

@@ -200,3 +200,43 @@ class TestRunnerRepositoryIntegration:
                 )
                 .run()
             )
+
+
+class TestTableRepository:
+    """Parquet-table repository (SparkTableMetricsRepository analog,
+    SURVEY.md §2.5): append-only files, last-write-wins dedupe at read,
+    result_key pushdown on point lookups."""
+
+    def test_round_trip_and_overwrite_semantics(self, context, tmp_path):
+        from deequ_tpu.analyzers import AnalysisRunner
+        from deequ_tpu.repository.table import TableMetricsRepository
+
+        repo = TableMetricsRepository(os.path.join(tmp_path, "tbl"))
+        key = ResultKey.of(100, {"run": "r1"})
+        repo.save(AnalysisResult(key, context))  # Size == 5
+        # re-save the SAME key with DIFFERENT content: the newer write
+        # must win regardless of (uuid-random) file enumeration order
+        v2 = AnalysisRunner.do_analysis_run(
+            Dataset.from_pydict({"x": [1.0, 2.0, 3.0]}), [Size()]
+        )
+        repo.save(AnalysisResult(key, v2))  # Size == 3
+        repo2 = TableMetricsRepository(os.path.join(tmp_path, "tbl"))
+        loaded = repo2.load_by_key(key)
+        assert loaded is not None
+        assert loaded.analyzer_context.metric(Size()).value.get() == 3.0
+        assert len(repo2.load().get()) == 1  # last write per key wins
+
+    def test_concurrent_style_appends_and_query(self, context, tmp_path):
+        from deequ_tpu.repository.table import TableMetricsRepository
+
+        path = os.path.join(tmp_path, "tbl")
+        # two independent writers (as from two hosts) never conflict
+        w1, w2 = TableMetricsRepository(path), TableMetricsRepository(path)
+        for t, env, repo in [(10, "dev", w1), (20, "prod", w2), (30, "prod", w1)]:
+            repo.save(AnalysisResult(ResultKey.of(t, {"env": env}), context))
+        reader = TableMetricsRepository(path)
+        got = reader.load().after(15).get()
+        assert [r.result_key.dataset_date for r in got] == [20, 30]
+        assert (
+            len(reader.load().with_tag_values({"env": "prod"}).get()) == 2
+        )
