@@ -377,6 +377,17 @@ def main():
         "vs_baseline": round(
             rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 4
         ),
+        # decomposition context: the tunneled chip's host->device link
+        # swings 4-1400 MB/s between runs and fresh-data walls are
+        # usually link-bound; resident_rows_per_sec is the chip's
+        # compute/dispatch capability with data in HBM (what a real pod
+        # reading from local storage at GB/s would see)
+        "link_mb_per_sec": round(
+            detail["profiler"]["link_mb_per_sec"], 2
+        ),
+        "resident_rows_per_sec": round(
+            detail["profiler"]["resident_rows_per_sec"], 1
+        ),
     }
     print(json.dumps(detail, indent=2), file=sys.stderr)
     print(json.dumps(result))

@@ -185,25 +185,19 @@ class AnalysisRunner:
         metadata = RunMetadata()
         rows = data.num_rows
 
-        # 4) ONE fused scan for every scan-shareable analyzer
-        if scan_shareable:
-            with timed_pass(metadata, "scan", rows, len(scan_shareable)):
+        # 4+5) ONE fused scan for every scan-shareable analyzer AND
+        # every dense grouping frequency plan — a mixed verification
+        # suite costs a single pass over the data (SURVEY.md §2.4);
+        # device-sort/Arrow spill plans run right after, reusing the
+        # chunks the shared scan just cached
+        if scan_shareable or grouping:
+            with timed_pass(
+                metadata, "scan", rows, len(scan_shareable) + len(grouping)
+            ):
                 metrics.update(
-                    _run_scanning_analyzers(
-                        data, scan_shareable, engine, aggregate_with,
-                        save_states_with,
-                    )
-                )
-
-        # 5) one frequency computation per (grouping columns, filter)
-        if grouping:
-            from deequ_tpu.analyzers.grouping import run_grouping_analyzers
-
-            with timed_pass(metadata, "grouping", rows, len(grouping)):
-                metrics.update(
-                    run_grouping_analyzers(
-                        data, grouping, engine, aggregate_with,
-                        save_states_with, metadata=metadata,
+                    _run_fused_pass(
+                        data, scan_shareable, grouping, engine,
+                        aggregate_with, save_states_with, metadata,
                     )
                 )
 
@@ -304,59 +298,128 @@ def _check_preconditions(
         return wrap_if_necessary(exc)
 
 
-def _run_scanning_analyzers(
+def _run_fused_pass(
     data: Dataset,
     analyzers: List[ScanShareableAnalyzer],
+    grouping: List[GroupingAnalyzer],
     engine: AnalysisEngine,
     aggregate_with,
     save_states_with,
+    metadata=None,
 ) -> Dict[Analyzer, Metric]:
-    """Plan + run the fused scan; per-analyzer plan failures (bad
+    """Plan + run THE fused scan: scan-shareable analyzers (vectorized
+    into stacked group ops, engine/vectorize.py) and dense grouping
+    frequency plans (scatter-add ScanOps, analyzers/grouping.py) all
+    ride one engine.run_scan — one pass over the data, one packed state
+    fetch. Device-sort / Arrow spill plans execute immediately after
+    against the chunks the scan cached. Per-analyzer plan failures (bad
     predicate, unknown column inside an expression) degrade to failure
-    metrics without aborting the shared pass. Same-family analyzers over
-    stackable columns ride vectorized group ops (engine/vectorize.py);
-    each member's ordinary state is sliced back out afterwards, so
-    persistence/merge semantics are identical to the single path."""
+    metrics without aborting the shared pass; each vectorized member's
+    ordinary state is sliced back out afterwards, so persistence/merge
+    semantics are identical to the single path."""
+    from deequ_tpu.analyzers.grouping import (
+        FrequencyScanAdapter,
+        finalize_dense_states,
+        finalize_grouping_metrics,
+        plan_frequency_passes,
+        plans_for,
+    )
     from deequ_tpu.engine.vectorize import plan_scan_units
 
     metrics: Dict[Analyzer, Metric] = {}
     units, plan_failures = plan_scan_units(data, analyzers)
     for analyzer, exc in plan_failures.items():
         metrics[analyzer] = analyzer.to_failure_metric(exc)
-    if not units:
+
+    by_plan = plans_for(grouping)
+    dense, deferred = [], {}
+    if by_plan:
+        try:
+            dense, deferred = plan_frequency_passes(
+                data,
+                list(by_plan.keys()),
+                engine,
+                events=None if metadata is None else metadata.events,
+            )
+        except Exception as exc:  # noqa: BLE001 — planning failed for
+            # the whole grouping family: every grouping analyzer fails
+            for group in by_plan.values():
+                for analyzer in group:
+                    metrics[analyzer] = analyzer.to_failure_metric(exc)
+            by_plan, dense, deferred = {}, [], {}
+
+    scan_pairs = [(unit, unit.ops) for unit in units] + [
+        (FrequencyScanAdapter(requests), ops)
+        for (_p, _d, _s, requests, ops) in dense
+    ]
+    if not scan_pairs and not deferred:
         return metrics
 
-    try:
-        states = engine.run_scan(
-            data, [(unit, unit.ops) for unit in units]
-        )
-    except Exception as exc:  # noqa: BLE001
-        wrapped = wrap_if_necessary(exc)
-        for unit in units:
-            for analyzer in unit.members:
-                metrics[analyzer] = analyzer.to_failure_metric(wrapped)
-        return metrics
+    states = None
+    if scan_pairs:
+        try:
+            states = engine.run_scan(data, scan_pairs)
+        except Exception as exc:  # noqa: BLE001
+            wrapped = wrap_if_necessary(exc)
+            for unit in units:
+                for analyzer in unit.members:
+                    metrics[analyzer] = analyzer.to_failure_metric(wrapped)
+            for plan, _dicts, _sizes, _req, _ops in dense:
+                for analyzer in by_plan.get(plan, []):
+                    metrics[analyzer] = analyzer.to_failure_metric(wrapped)
+            dense = []
 
-    for unit, unit_state in zip(units, states):
-        for member_idx, analyzer in enumerate(unit.members):
+    if states is not None:
+        for unit, unit_state in zip(units, states[: len(units)]):
+            for member_idx, analyzer in enumerate(unit.members):
+                try:
+                    if unit.extract is not None:
+                        state = unit.extract(unit_state, member_idx)
+                        merge = _merge_fn_for(state)
+                    else:
+                        state = unit_state
+                        merge = unit.ops.merge
+                    if aggregate_with is not None:
+                        prior = aggregate_with.load(analyzer)
+                        if prior is not None:
+                            state = merge(state, prior)
+                    if save_states_with is not None:
+                        save_states_with.persist(analyzer, state)
+                    metrics[analyzer] = analyzer.compute_metric_from_state(
+                        state
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    metrics[analyzer] = analyzer.to_failure_metric(exc)
+
+    # grouping finalize: dense states from the shared scan + deferred
+    # spill passes; exceptions stay per-plan (one plan's bad decode
+    # must not discard its siblings' valid states)
+    frequencies: Dict[Any, Any] = {}
+    if states is not None and dense:
+        for spec, state in zip(dense, states[len(units):]):
             try:
-                if unit.extract is not None:
-                    state = unit.extract(unit_state, member_idx)
-                    merge = _merge_fn_for(state)
-                else:
-                    state = unit_state
-                    merge = unit.ops.merge
-                if aggregate_with is not None:
-                    prior = aggregate_with.load(analyzer)
-                    if prior is not None:
-                        state = merge(state, prior)
-                if save_states_with is not None:
-                    save_states_with.persist(analyzer, state)
-                metrics[analyzer] = analyzer.compute_metric_from_state(
-                    state
+                frequencies.update(
+                    finalize_dense_states([spec], [state])
                 )
             except Exception as exc:  # noqa: BLE001
-                metrics[analyzer] = analyzer.to_failure_metric(exc)
+                frequencies[spec[0]] = exc
+    for plan, run in deferred.items():
+        try:
+            frequencies[plan] = run()
+        except Exception as exc:  # noqa: BLE001
+            frequencies[plan] = exc
+    grouped_plans = {
+        plan: group
+        for plan, group in by_plan.items()
+        if plan in frequencies
+    }
+    if grouped_plans:
+        metrics.update(
+            finalize_grouping_metrics(
+                grouped_plans, frequencies, aggregate_with,
+                save_states_with,
+            )
+        )
     return metrics
 
 

@@ -62,11 +62,23 @@ def _key_json(key: ResultKey) -> str:
 
 
 class TableMetricsRepository(MetricsRepository):
-    """Append-only parquet-table repository (one file per save)."""
+    """Append-only parquet-table repository (one file per save).
+
+    Last-write-wins ordering uses wall-clock nanoseconds made strictly
+    monotonic WITHIN this writer (ties and NTP steps backwards bump
+    past the previous seq). Across hosts, ordering is wall-clock
+    best-effort — the same contract as any timestamp-ordered warehouse
+    append; writers needing strict cross-host ordering must serialize
+    saves themselves."""
 
     def __init__(self, path: str):
         self._path = path
+        self._last_seq = 0
         os.makedirs(path, exist_ok=True)
+
+    def _next_seq(self) -> int:
+        self._last_seq = max(time.time_ns(), self._last_seq + 1)
+        return self._last_seq
 
     def save(self, result: AnalysisResult) -> None:
         key = result.result_key
@@ -75,7 +87,7 @@ class TableMetricsRepository(MetricsRepository):
                 "result_key": [_key_json(key)],
                 "dataset_date": [int(key.dataset_date)],
                 "tags": [json.dumps(key.tags_dict, sort_keys=True)],
-                "seq": [time.time_ns()],
+                "seq": [self._next_seq()],
                 "serialized_context": [serde.serialize([result])],
             },
             schema=_SCHEMA,
@@ -101,15 +113,18 @@ class TableMetricsRepository(MetricsRepository):
         ):
             # last write per key wins (the reference overwrites on
             # save; an append-only table keeps history — dedupe at read
-            # by the monotonic write sequence, NOT file enumeration
-            # order, which is uuid-random)
+            # by the write sequence, NOT file enumeration order, which
+            # is uuid-random)
             prior = seen.get(key_json)
             if prior is None or seq > prior[0]:
                 seen[key_json] = (seq, payload)
         for _, payload in seen.values():
             out.extend(serde.deserialize(payload))
-        # deterministic order regardless of file enumeration order
-        out.sort(key=lambda r: r.result_key.dataset_date)
+        # deterministic order regardless of file enumeration order:
+        # date, then the canonical key json as the same-date tie-break
+        out.sort(
+            key=lambda r: (r.result_key.dataset_date, _key_json(r.result_key))
+        )
         return out
 
     def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:

@@ -72,10 +72,11 @@ class TestRunMetadata:
         meta = ctx.run_metadata
         assert meta is not None
         names = [p.name for p in meta.passes]
-        assert names == ["scan", "grouping"]
+        # scan-shareable AND grouping analyzers fuse into ONE pass
+        assert names == ["scan"]
         for p in meta.passes:
             assert p.wall_s > 0 and p.rows == 1000
-        assert meta.passes[0].num_analyzers == 2
+        assert meta.passes[0].num_analyzers == 3
         assert meta.total_wall_s > 0
         assert meta.as_records()[0]["pass"] == "scan"
 
@@ -113,11 +114,11 @@ class TestRunMetadata:
         profiles = ColumnProfiler.profile(ds)
         meta = profiles.run_metadata
         assert meta is not None
-        # fused pass 1 (generic + native-numeric stats) + histogram pass
-        # (native numeric stats ride pass 1; a separate numeric pass
-        # only exists for promoted string columns)
+        # fused pass 1 (generic + native-numeric stats) + the histogram
+        # run (its own fused scan — histogram COLUMN selection depends
+        # on pass 1's cardinalities, so it cannot merge into pass 1)
         names = [p.name for p in meta.passes]
-        assert names == ["scan", "grouping"]
+        assert names == ["scan", "scan"]
 
 
 class TestPlanCache:

