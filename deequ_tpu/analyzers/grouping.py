@@ -311,6 +311,31 @@ def plan_frequency_passes(
             )
             dense.append((plan, dictionaries, sizes, requests, ops))
             remaining -= padded
+        elif (
+            joint is not None
+            and len(plan.columns) > 1
+            and (engine is None or engine.mesh is None)
+            and spill_mod.joint_spill_eligible(
+                dataset, plan, [s + 1 for s in sizes_maybe], engine
+            )
+        ):
+            # known per-column cardinalities whose JOINT space exceeds
+            # the dense budget but fits a u64 sort lane: pack the joint
+            # code and take the device sort path
+            dictionaries = [dataset.dictionary(c) for c in plan.columns]
+            sizes = [len(d) + 1 for d in dictionaries]
+
+            def make_joint(plan, dictionaries, sizes):
+                def run():
+                    result = spill_mod.device_spill_joint_frequencies(
+                        dataset, plan, engine, dictionaries, sizes
+                    )
+                    note(plan, "device-sort-joint")  # after success
+                    return result
+
+                return run
+
+            deferred[plan] = make_joint(plan, dictionaries, sizes)
         else:
             deferred[plan] = make_arrow(plan)
     return dense, deferred

@@ -65,6 +65,31 @@ _BIAS = np.uint64(1) << np.uint64(63)
 
 
 @functools.lru_cache(maxsize=None)
+def _joint_chunk_key_fn(n_columns: int):
+    """Jitted: one scan chunk's per-column codes + masks -> flat u64
+    JOINT keys (code+1 digits in mixed radix ``sizes``, null -> slot 0,
+    exactly the dense path's joint-code math) with sentinel for
+    non-contributing rows. Multi-column plans exclude only rows where
+    ALL grouping columns are null (the reference's
+    atLeastOneNonNullGroupingColumn)."""
+
+    def build(codes, masks, rows, sizes):
+        any_non_null = jnp.zeros_like(rows)
+        for m in masks:
+            any_non_null = any_non_null | m
+        contributes = rows & any_non_null
+        keys = jnp.zeros(rows.shape, dtype=jnp.uint64)
+        for j in range(n_columns):
+            shifted = (codes[j].astype(jnp.int64) + 1).astype(jnp.uint64)
+            keys = keys * sizes[j].astype(jnp.uint64) + shifted
+        keys = jnp.where(contributes, keys, _SENTINEL)
+        n_sentinel = jnp.sum(~contributes, dtype=jnp.int64)
+        return keys.ravel(), n_sentinel
+
+    return jax.jit(build)
+
+
+@functools.lru_cache(maxsize=None)
 def _chunk_key_fn(key_kind: str, include_nulls: bool):
     """Jitted: one scan chunk -> (flat u64 keys with sentinel for
     non-contributing rows, #sentinel rows, #null rows kept).
@@ -367,10 +392,16 @@ class DeviceFrequencies(FrequenciesAndNumRows):
         counts,
         null_rows: int,
         include_nulls: bool,
+        joint=None,  # (dictionaries, sizes): multi-column joint codes
     ):
         self.columns = tuple(columns)
         self._values_dtype = np.dtype(values_dtype)
         self._is_float = self._values_dtype.kind == "f"
+        self._joint = joint
+        # base-class lazy-decode slots (joint mode feeds _lazy after
+        # fetch and inherits keys/non_null_group_mask, incl. caching)
+        self._keys = None
+        self._lazy = None
         self._num_segments = int(scalars["num_segments"])
         self._value_groups = int(scalars["num_groups"])
         self._unique = int(scalars["unique"])
@@ -405,6 +436,13 @@ class DeviceFrequencies(FrequenciesAndNumRows):
             live = raw_counts > 0  # drops a zeroed sentinel segment
             self._keys_host = raw_keys[live]
             self._counts_host = raw_counts[live].astype(np.int64)
+        if self._joint is not None and self._lazy is None:
+            dictionaries, sizes = self._joint
+            self._lazy = (
+                self._keys_host.astype(np.int64),
+                list(dictionaries),
+                list(sizes),
+            )
 
     def _decode_keys(self, raw: np.ndarray) -> np.ndarray:
         """(K,) raw u64 keys -> (K,) object values in the column's OWN
@@ -436,6 +474,10 @@ class DeviceFrequencies(FrequenciesAndNumRows):
     @property
     def keys(self) -> np.ndarray:
         self._fetch()
+        if self._joint is not None:
+            # inherit the base class's cached lazy decode (ONE radix
+            # walk however many times merge/persistence read .keys)
+            return FrequenciesAndNumRows.keys.fget(self)
         n = self.num_groups
         out = np.empty((n, 1), dtype=object)
         out[: len(self._keys_host), 0] = self._decode_keys(self._keys_host)
@@ -444,6 +486,9 @@ class DeviceFrequencies(FrequenciesAndNumRows):
         return out
 
     def non_null_group_mask(self) -> np.ndarray:
+        if self._joint is not None:
+            self._fetch()
+            return FrequenciesAndNumRows.non_null_group_mask(self)
         mask = np.ones(self.num_groups, dtype=bool)
         if self._has_null_group:
             mask[-1] = False
@@ -457,11 +502,18 @@ class DeviceFrequencies(FrequenciesAndNumRows):
     def entropy_nats(self) -> float:
         from deequ_tpu.analyzers.base import EmptyStateException
 
+        if self._joint is not None:
+            # joint plans can hold PARTIALLY-null groups, which entropy
+            # excludes — the on-device scalar summed all groups, so fall
+            # back to the host fold over the fetched distribution
+            return FrequenciesAndNumRows.entropy_nats(self)
         if self.num_rows - self._null_rows == 0:
             raise EmptyStateException("Entropy over empty distribution.")
         return self._entropy
 
     def top_groups(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._joint is not None:  # multi-column: host decode path
+            return FrequenciesAndNumRows.top_groups(self, k)
         gk, c = self._dev
         kk = min(k, self._num_segments)
         pairs = []
@@ -578,6 +630,108 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
     # padded); 64 B/row keeps the whole pass clear of HBM even when the
     # budget is sized close to the device memory
     return dataset.num_rows * 64 <= opts.device_cache_bytes
+
+
+def joint_spill_eligible(
+    dataset: Dataset, plan, sizes, engine=None
+) -> bool:
+    """Multi-column variant: dictionaries exist for every column (the
+    dense-path probe already built them) and the joint mixed-radix key
+    space fits u64's value range with headroom below the sentinel."""
+    from deequ_tpu import config
+
+    opts = config.options()
+    if not opts.device_spill_grouping or not opts.device_cache_bytes:
+        return False
+    if opts.engine == "cpu":
+        return False
+    if plan.include_nulls:
+        # the joint kernel drops all-null rows; include_nulls plans
+        # (Histogram's null bin) keep the dense/Arrow paths
+        return False
+    if dataset.num_rows >= 2**31:
+        return False
+    joint = 1
+    for s in sizes:
+        joint *= s
+        if joint >= 2**62:
+            return False
+    return dataset.num_rows * 64 <= opts.device_cache_bytes
+
+
+def device_spill_joint_frequencies(
+    dataset: Dataset, plan, engine, dictionaries, sizes
+) -> "DeviceFrequencies":
+    """Multi-column high-cardinality frequencies on device: joint codes
+    (the dense path's mixed-radix math) packed into ONE u64 sort lane —
+    covers joint key spaces past the dense scatter budget but within
+    2^62 (e.g. two 100k-cardinality columns under Uniqueness)."""
+    from deequ_tpu import config
+    from deequ_tpu.engine.scan import CHUNK_BATCHES
+
+    columns = list(plan.columns)
+    requests = [ColumnRequest(c, "codes") for c in columns] + [
+        ColumnRequest(c, "mask") for c in columns
+    ]
+    pred = None
+    if plan.where is not None:
+        from deequ_tpu.sql.predicate import compile_predicate
+
+        pred = compile_predicate(plan.where, dataset)
+        requests += list(pred.requests)
+
+    batch_size = engine._resolve_batch_size(dataset.num_rows)
+    nb = dataset.num_batches(batch_size)
+    chunk_batches = min(CHUNK_BATCHES, nb)
+    key_fn = _joint_chunk_key_fn(len(columns))
+    sizes_dev = jnp.asarray(np.asarray(sizes, dtype=np.int64))
+
+    keys_parts = []
+    n_sentinel = jnp.int64(0)
+    for chunk in dataset.device_scan_chunks(
+        requests,
+        batch_size,
+        chunk_batches=chunk_batches,
+        budget_bytes=config.options().device_cache_bytes,
+    ):
+        rows = chunk[ROW_MASK]
+        if pred is not None:
+            flat = {k: v.reshape(-1) for k, v in chunk.items()}
+            rows = rows & pred.complies(flat).reshape(rows.shape)
+        k, ns = key_fn(
+            tuple(chunk[f"{c}::codes"] for c in columns),
+            tuple(chunk[f"{c}::mask"] for c in columns),
+            rows,
+            sizes_dev,
+        )
+        keys_parts.append(k)
+        n_sentinel = n_sentinel + ns
+
+    keys = (
+        jnp.concatenate(keys_parts) if len(keys_parts) > 1 else keys_parts[0]
+    )
+    n = keys.shape[0]
+    padded = 1 << max(1, int(n - 1).bit_length()) if n > 1 else 1
+    if padded != n:
+        keys = jnp.concatenate(
+            [keys, jnp.full(padded - n, _SENTINEL, dtype=keys.dtype)]
+        )
+        n_sentinel = n_sentinel + (padded - n)
+
+    scalars, group_keys, counts = _finalize_fn()(keys, n_sentinel)
+    from deequ_tpu.engine.pack import packed_device_get
+
+    scalars = packed_device_get(scalars)
+    return DeviceFrequencies(
+        plan.columns,
+        np.dtype(np.int64),
+        scalars,
+        group_keys,
+        counts,
+        0,
+        False,
+        joint=(list(dictionaries), list(sizes)),
+    )
 
 
 def device_spill_frequencies(

@@ -145,6 +145,60 @@ class TestSpillStateInterop:
         }
         assert got == {1: 1, 2: 2, 3: 2, 4: 2, 5: 1}
 
+    def test_joint_multicolumn_spill_equals_host(self):
+        """Two-column plans whose joint key space exceeds the dense
+        budget but fits a u64 lane take the packed-joint-code device
+        sort; results must equal the Arrow host path exactly."""
+        rng = np.random.default_rng(17)
+        a = rng.integers(0, 300, 6_000).astype(object)
+        b = rng.integers(0, 300, 6_000).astype(object)
+        a[::31] = None
+        b[::17] = None
+        ds = Dataset.from_pydict({"a": list(a), "b": list(b)})
+        analyzers = [
+            CountDistinct(["a", "b"]),
+            Uniqueness(["a", "b"]),
+            Distinctness(["a", "b"]),
+            Entropy(["a", "b"]),
+        ]
+        # force the dense path out: joint (301*301 ~ 90k) > budget slots
+        with config.configure(dense_grouping_budget_bytes=4 * 1024):
+            device = _metrics(ds, analyzers, spill=True)
+            host = _metrics(ds, analyzers, spill=False)
+        for z in analyzers:
+            d, h = device[z].value, host[z].value
+            assert d.is_success and h.is_success, (z, d, h)
+            assert d.get() == pytest.approx(h.get(), rel=1e-9), z
+
+    def test_joint_spill_event_and_merge(self):
+        from deequ_tpu.analyzers.grouping import (
+            FrequenciesAndNumRows,
+            FrequencyPlan,
+            compute_many_frequencies,
+        )
+
+        x = Dataset.from_pydict({"a": [1, 1, 2], "b": [5, 5, 6]})
+        y = Dataset.from_pydict({"a": [2, 3], "b": [6, 7]})
+        plan = FrequencyPlan(("a", "b"), None, False)
+        with config.configure(
+            dense_grouping_budget_bytes=16,  # joint (4*4=16) > 4 slots
+            device_spill_grouping=True,
+        ):
+            events = []
+            fx = compute_many_frequencies(x, [plan], events=events)[plan]
+            assert any(
+                e["path"] == "device-sort-joint" for e in events
+            ), events
+        with config.configure(device_spill_grouping=False):
+            fy = compute_many_frequencies(y, [plan])[plan]
+        merged = FrequenciesAndNumRows.merge(fx, fy)
+        got = {
+            (k[0], k[1]): c
+            for k, c in zip(merged.keys, merged.counts)
+        }
+        assert got == {(1, 5): 2, (2, 6): 2, (3, 7): 1}
+        assert merged.num_rows == 5
+
     def test_sharded_spill_equals_single_device(self, cpu_mesh):
         """The hash-bucket all_to_all re-shard (SURVEY §7 hard part #1):
         a high-cardinality int column under an 8-device mesh must give
