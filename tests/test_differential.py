@@ -1,0 +1,107 @@
+"""Differential execution-path tests: the SAME analyzer set over the
+SAME data must produce equal metrics through every engine path —
+resident (chunk-pipelined device cache), streaming (bit-packed batches,
+no cache), and the 8-virtual-device mesh. This is the engine-level
+analogue of the reference's local-vs-cluster equivalence assumption."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Dataset, config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxCountDistinct,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.engine import AnalysisEngine
+
+
+def _mixed_dataset(seed: int, n: int = 40_000) -> Dataset:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(5.0, 2.0, n).astype(object)
+    x[:: rng.integers(5, 20)] = None
+    return Dataset.from_pydict(
+        {
+            "x": list(x),
+            "y": list(rng.normal(-1.0, 1.0, n)),
+            "k": list(rng.integers(0, n // 2, n, dtype=np.int64)),
+            "s": list(
+                np.array(["red", "green", "blue", "17", ""])[
+                    rng.integers(0, 5, n)
+                ]
+            ),
+        }
+    )
+
+
+def _analyzers():
+    return [
+        Mean("x"),
+        Sum("y"),
+        Minimum("x"),
+        Maximum("y"),
+        StandardDeviation("x"),
+        Completeness("x"),
+        Correlation("x", "y"),
+        Compliance("pos", "x > 5"),
+        MinLength("s"),
+        MaxLength("s"),
+        DataType("s"),
+        ApproxCountDistinct("k"),
+        CountDistinct("k"),
+        Uniqueness("k"),
+    ]
+
+
+def _values(ctx, analyzers):
+    out = {}
+    for a in analyzers:
+        v = ctx.metric(a).value
+        assert v.is_success, (a, v)
+        value = v.get()
+        out[a] = value if isinstance(value, float) else str(value)
+    return out
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_resident_streaming_mesh_agree(seed, cpu_mesh):
+    data_factory = lambda: _mixed_dataset(seed)  # noqa: E731
+    analyzers = _analyzers()
+
+    resident = _values(
+        AnalysisRunner.do_analysis_run(data_factory(), analyzers),
+        analyzers,
+    )
+    with config.configure(device_cache_bytes=0, batch_size=4_096):
+        streaming = _values(
+            AnalysisRunner.do_analysis_run(data_factory(), analyzers),
+            analyzers,
+        )
+    meshed = _values(
+        AnalysisRunner.do_analysis_run(
+            data_factory(),
+            analyzers,
+            engine=AnalysisEngine(mesh=cpu_mesh, batch_size=8_192),
+        ),
+        analyzers,
+    )
+    for a in analyzers:
+        for other, name in ((streaming, "streaming"), (meshed, "mesh")):
+            if isinstance(resident[a], float):
+                assert other[a] == pytest.approx(
+                    resident[a], rel=1e-9, abs=1e-12
+                ), (a, name)
+            else:
+                assert other[a] == resident[a], (a, name)
