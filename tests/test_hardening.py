@@ -152,3 +152,61 @@ class TestEngineSelectionFlag:
             assert default_ctx.metric(a).value.get() == pytest.approx(
                 cpu_ctx.metric(a).value.get(), rel=1e-12
             ), a
+
+
+class TestEdgeDtypes:
+    """Narrow/unsigned/half dtypes flow through the whole engine with
+    numpy-oracle-exact basic stats (the wire-narrowing and widening
+    rules must never change a metric)."""
+
+    def test_all_numeric_storage_dtypes(self):
+        import pyarrow as pa
+
+        from deequ_tpu import Dataset
+        from deequ_tpu.analyzers import (
+            AnalysisRunner,
+            CountDistinct,
+            Maximum,
+            Mean,
+            Minimum,
+            Sum,
+        )
+
+        rng = np.random.default_rng(8)
+        cols = {
+            "i8": rng.integers(-100, 100, 4_000).astype(np.int8),
+            "u16": rng.integers(0, 60_000, 4_000).astype(np.uint16),
+            "u32": rng.integers(1 << 31, 1 << 32, 4_000).astype(np.uint32),
+            "i64": rng.integers(-(1 << 60), 1 << 60, 4_000),
+            "f16": rng.normal(0, 1, 4_000).astype(np.float16),
+            "f32": rng.normal(0, 1, 4_000).astype(np.float32),
+        }
+        ds = Dataset.from_arrow(
+            pa.table({k: pa.array(v) for k, v in cols.items()})
+        )
+        analyzers = []
+        for c in cols:
+            analyzers += [Mean(c), Minimum(c), Maximum(c), Sum(c)]
+        analyzers += [CountDistinct("u32"), CountDistinct("f32")]
+        ctx = AnalysisRunner.do_analysis_run(ds, analyzers)
+        for c, vals in cols.items():
+            # f16 materializes as f32 on the wire; the oracle follows
+            wide = vals.astype(np.float64)
+            assert ctx.metric(Mean(c)).value.get() == pytest.approx(
+                float(wide.mean()), rel=1e-6
+            ), c
+            assert ctx.metric(Minimum(c)).value.get() == pytest.approx(
+                float(wide.min())
+            ), c
+            assert ctx.metric(Maximum(c)).value.get() == pytest.approx(
+                float(wide.max())
+            ), c
+            assert ctx.metric(Sum(c)).value.get() == pytest.approx(
+                float(wide.sum()), rel=1e-6
+            ), c
+        assert ctx.metric(CountDistinct("u32")).value.get() == float(
+            len(np.unique(cols["u32"]))
+        )
+        assert ctx.metric(CountDistinct("f32")).value.get() == float(
+            len(np.unique(cols["f32"]))
+        )

@@ -233,6 +233,50 @@ class TestSpillStateInterop:
             v.absolute for v in hs.values.values()
         ) == sorted(v.absolute for v in hm.values.values())
 
+    def test_sharded_state_persists_and_reloads(self, cpu_mesh, tmp_path):
+        """A ShardedDeviceFrequencies state round-trips through the
+        FileSystemStateProvider like any dense-path state."""
+        from deequ_tpu import FileSystemStateProvider
+        from deequ_tpu.engine import AnalysisEngine
+
+        rng = np.random.default_rng(41)
+        ds = Dataset.from_pydict(
+            {"id": list(rng.integers(0, 2_000, 8_000, dtype=np.int64))}
+        )
+        a = CountDistinct("id")
+        provider = FileSystemStateProvider(str(tmp_path))
+        ctx = AnalysisRunner.do_analysis_run(
+            ds, [a], engine=AnalysisEngine(mesh=cpu_mesh),
+            save_states_with=provider,
+        )
+        want = ctx.metric(a).value.get()
+        reloaded = provider.load(a)
+        assert reloaded is not None
+        assert a.compute_metric_from_state(reloaded).value.get() == want
+
+    def test_sharded_spill_with_where_filter(self, cpu_mesh):
+        from deequ_tpu.engine import AnalysisEngine
+
+        rng = np.random.default_rng(33)
+        ds = Dataset.from_pydict(
+            {
+                "id": list(rng.integers(0, 4_000, 16_000, dtype=np.int64)),
+                "flag": list(rng.integers(0, 2, 16_000, dtype=np.int64)),
+            }
+        )
+        analyzers = [
+            CountDistinct("id", where="flag = 1"),
+            Uniqueness("id", where="flag = 1"),
+        ]
+        single = AnalysisRunner.do_analysis_run(ds, analyzers)
+        meshed = AnalysisRunner.do_analysis_run(
+            ds, analyzers, engine=AnalysisEngine(mesh=cpu_mesh)
+        )
+        for a in analyzers:
+            assert meshed.metric(a).value.get() == pytest.approx(
+                single.metric(a).value.get(), rel=1e-9
+            ), a
+
     def test_spill_event_recorded_in_run_metadata(self):
         rng = np.random.default_rng(3)
         ds = Dataset.from_pydict(
