@@ -11,6 +11,7 @@ anomaly detection.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -129,18 +130,24 @@ class MetricsRepositoryMultipleResultsLoader:
 
 
 class InMemoryMetricsRepository(MetricsRepository):
-    """Reference: repository/memory/InMemoryMetricsRepository.scala."""
+    """Reference: repository/memory/InMemoryMetricsRepository.scala —
+    which uses a ConcurrentHashMap (SURVEY.md §5.2); a lock gives the
+    same concurrent-writer safety here."""
 
     def __init__(self) -> None:
         self._store: Dict[ResultKey, AnalysisResult] = {}
+        self._lock = threading.Lock()
 
     def save(self, result: AnalysisResult) -> None:
-        self._store[result.result_key] = result
+        with self._lock:
+            self._store[result.result_key] = result
 
     def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:
-        return self._store.get(key)
+        with self._lock:
+            return self._store.get(key)
 
     def load(self) -> MetricsRepositoryMultipleResultsLoader:
-        return MetricsRepositoryMultipleResultsLoader(
-            list(self._store.values())
-        )
+        with self._lock:
+            return MetricsRepositoryMultipleResultsLoader(
+                list(self._store.values())
+            )

@@ -240,3 +240,71 @@ class TestTableRepository:
         assert (
             len(reader.load().with_tag_values({"env": "prod"}).get()) == 2
         )
+
+
+class TestConcurrency:
+    """SURVEY §5.2: the reference's only shared mutable state is the
+    in-memory provider/repository pair (ConcurrentHashMap there); both
+    must tolerate concurrent writers here."""
+
+    def test_state_provider_concurrent_writers(self):
+        import threading
+
+        from deequ_tpu.io import InMemoryStateProvider
+        from deequ_tpu.analyzers import Mean, Size
+        from deequ_tpu.analyzers.states import SumState
+
+        provider = InMemoryStateProvider()
+        errors = []
+
+        def writer(col):
+            try:
+                a = Mean(col)
+                for i in range(200):
+                    provider.persist(a, SumState(float(i), i))
+                    provider.load(a)
+                    provider.load(Size())
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=writer, args=(f"c{j}",))
+            for j in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for j in range(4):
+            state = provider.load(Mean(f"c{j}"))
+            assert state is not None and int(state.count) == 199
+
+    def test_concurrent_saves_and_loads(self, context):
+        import threading
+
+        repo = InMemoryMetricsRepository()
+        errors = []
+
+        def writer(t0):
+            try:
+                for i in range(50):
+                    repo.save(
+                        AnalysisResult(
+                            ResultKey.of(t0 + i, {"w": str(t0)}), context
+                        )
+                    )
+                    repo.load().after(0).get()
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=writer, args=(base,))
+            for base in (0, 1000, 2000, 3000)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(repo.load().get()) == 200
