@@ -42,6 +42,30 @@ def _timed(fn):
     return wall, shipped, (shipped / wall / 1e6 if wall > 0 else 0.0), result
 
 
+def _phases(run_metadata):
+    """Sum the engine's per-pass wall decomposition events into one
+    dict (VERDICT r3 next #2): host_wait_s = source read/convert;
+    put_s = transfer dispatch incl. link backpressure; dispatch_s =
+    jitted step dispatch; first_step_s = the first step alone (carries
+    any trace/compile cost, so cold runs don't read as dispatch
+    overhead); sync_s = blocked on the device queue (remaining
+    transfers + compute). wall ≈ sum of the five; under a saturated
+    link, attribution BETWEEN buckets is indicative only (GIL/
+    backpressure smear — see engine.scan._PhaseClock)."""
+    out = {}
+    for e in (run_metadata.events if run_metadata else []):
+        if e.get("event") != "scan_phases":
+            continue
+        for k, v in e.items():
+            if isinstance(v, float):
+                out[k] = out.get(k, 0.0) + v
+        out["scan_passes"] = out.get("scan_passes", 0) + 1
+    return {
+        k: (round(v, 3) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
+
+
 def _tpcds_like(num_rows: int, num_cols: int, seed: int):
     """A store_sales-shaped synthetic table: ~60% numeric measures,
     ~20% integral keys, ~20% low-cardinality categorical strings."""
@@ -92,6 +116,7 @@ def bench_profiler(num_rows: int, num_cols: int):
         "rows_per_sec": num_rows / wall,
         "bytes_shipped": shipped,
         "link_mb_per_sec": mbps,
+        "phases": _phases(profiles.run_metadata),
     }
     if profiles.run_metadata is not None:
         out["passes"] = profiles.run_metadata.as_records()
@@ -144,7 +169,7 @@ def bench_fused_bundle(num_rows: int):
 
     AnalysisRunner.do_analysis_run(make(1), analyzers)  # warm compile
     fresh = make(2)
-    wall, shipped, mbps, _ = _timed(
+    wall, shipped, mbps, ctx = _timed(
         lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
     )
     return {
@@ -152,6 +177,7 @@ def bench_fused_bundle(num_rows: int):
         "rows_per_sec": num_rows / wall,
         "bytes_shipped": shipped,
         "link_mb_per_sec": mbps,
+        "phases": _phases(ctx.run_metadata),
     }
 
 
@@ -191,7 +217,7 @@ def bench_grouping(num_rows: int):
 
     AnalysisRunner.do_analysis_run(make(1), analyzers)
     fresh = make(2)
-    wall, shipped, mbps, _ = _timed(
+    wall, shipped, mbps, ctx = _timed(
         lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
     )
     return {
@@ -199,6 +225,7 @@ def bench_grouping(num_rows: int):
         "rows_per_sec": num_rows / wall,
         "bytes_shipped": shipped,
         "link_mb_per_sec": mbps,
+        "phases": _phases(ctx.run_metadata),
     }
 
 
@@ -227,7 +254,7 @@ def bench_sketches(num_rows: int):
     analyzers = [ApproxCountDistinct("id"), ApproxQuantile("x", 0.5)]
     AnalysisRunner.do_analysis_run(make(1), analyzers)
     fresh = make(2)
-    wall, shipped, mbps, _ = _timed(
+    wall, shipped, mbps, ctx = _timed(
         lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
     )
     return {
@@ -235,6 +262,7 @@ def bench_sketches(num_rows: int):
         "rows_per_sec": num_rows / wall,
         "bytes_shipped": shipped,
         "link_mb_per_sec": mbps,
+        "phases": _phases(ctx.run_metadata),
     }
 
 
@@ -336,7 +364,7 @@ def bench_streaming_parquet(num_rows: int, num_cols: int):
             )
         with config.configure(device_cache_bytes=0, batch_size=1 << 19):
             ColumnProfiler.profile(Dataset.from_parquet(workdir))  # warm
-            wall, shipped, mbps, _ = _timed(
+            wall, shipped, mbps, profiles = _timed(
                 lambda: ColumnProfiler.profile(Dataset.from_parquet(workdir))
             )
         return {
@@ -344,7 +372,113 @@ def bench_streaming_parquet(num_rows: int, num_cols: int):
             "rows_per_sec": num_rows / wall,
             "bytes_shipped": shipped,
             "link_mb_per_sec": mbps,
+            "phases": _phases(profiles.run_metadata),
         }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
+    """BASELINE.json config 2 at its SPECIFIED scale, streamed:
+    Mean/StdDev/Min/Max/Compliance over 10 numeric f32 columns,
+    100M rows read from multi-file parquet with the device cache off —
+    nothing above 32M rows had ever executed before r4 (VERDICT r3
+    next #2). Generated shard-by-shard so host memory stays bounded;
+    the measured run re-streams every byte storage->host->device."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(11)
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_100m_")
+
+    def shard_table(rows: int) -> "pa.Table":
+        return pa.table(
+            {
+                f"n{j}": rng.normal(0.0, 1.0, rows).astype(np.float32)
+                for j in range(10)
+            }
+        )
+
+    try:
+        shard_rows = 12_500_000
+        gen_t0 = time.time()
+        done = 0
+        i = 0
+        while done < num_rows:
+            rows = min(shard_rows, num_rows - done)
+            pq.write_table(
+                shard_table(rows), f"{workdir}/part{i:02d}.parquet"
+            )
+            done += rows
+            i += 1
+        gen_s = time.time() - gen_t0
+
+        analyzers = []
+        for j in range(10):
+            analyzers += [
+                Mean(f"n{j}"),
+                StandardDeviation(f"n{j}"),
+                Minimum(f"n{j}"),
+                Maximum(f"n{j}"),
+            ]
+        analyzers.append(Compliance("n0 pos", "n0 > 0"))
+
+        with config.configure(device_cache_bytes=0, batch_size=1 << 21):
+            # warm the compiles on a tiny same-schema parquet (identical
+            # batch shape: the tail batch pads to the same 2M width)
+            warmdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_100m_w_")
+            try:
+                pq.write_table(
+                    shard_table(1 << 21), f"{warmdir}/part.parquet"
+                )
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_parquet(warmdir), analyzers
+                )
+            finally:
+                shutil.rmtree(warmdir, ignore_errors=True)
+
+            wall, shipped, mbps, ctx = _timed(
+                lambda: AnalysisRunner.do_analysis_run(
+                    Dataset.from_parquet(workdir), analyzers
+                )
+            )
+        bytes_per_row = shipped / num_rows if num_rows else 0.0
+        out = {
+            "wall_s": wall,
+            "rows_per_sec": num_rows / wall,
+            "bytes_shipped": shipped,
+            "bytes_per_row": round(bytes_per_row, 2),
+            "link_mb_per_sec": mbps,
+            "gen_parquet_s": gen_s,
+            "phases": _phases(ctx.run_metadata),
+        }
+        # extrapolation to the 1B x 50-col north star, stated as math
+        # on THIS config's measurements (VERDICT r3 next #2): 1B rows
+        # at 5x the columns ships 5x the bytes/row; v5e-8 divides the
+        # stream over 8 chips each with its own host link
+        if mbps > 0:
+            out["projected_1b_x50_wall_s_link_bound_8chip"] = round(
+                1e9 * bytes_per_row * 5 / (mbps * 1e6) / 8, 1
+            )
+            out["projection_math"] = (
+                f"1e9 rows * {bytes_per_row:.1f} B/row * 5 (50/10 cols)"
+                f" / {mbps:.1f} MB/s / 8 chips"
+            )
+        return out
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -365,6 +499,7 @@ def main():
         detail["streaming_parquet"] = bench_streaming_parquet(
             4_000_000, 10
         )
+        detail["streaming_bundle_100m"] = bench_streaming_bundle_100m()
     except Exception as exc:  # secondary configs must not kill the line
         detail["error"] = repr(exc)
 

@@ -392,6 +392,10 @@ def compute_many_frequencies(
                 for (_p, _d, _s, requests, ops) in dense
             ],
         )
+        if events is not None and engine.phase_times is not None:
+            # same one-event-per-run_scan contract as the runner's
+            # fused pass, so _phases-style consumers see every scan
+            events.append({"event": "scan_phases", **engine.phase_times})
         results.update(finalize_dense_states(dense, states))
     return results
 

@@ -359,6 +359,10 @@ def _run_fused_pass(
     if scan_pairs:
         try:
             states = engine.run_scan(data, scan_pairs)
+            if metadata is not None and engine.phase_times is not None:
+                metadata.events.append(
+                    {"event": "scan_phases", **engine.phase_times}
+                )
         except Exception as exc:  # noqa: BLE001
             wrapped = wrap_if_necessary(exc)
             for unit in units:

@@ -145,3 +145,52 @@ class TestParquetStreaming:
         assert one.num_rows == 1000
         assert one.num_columns == 3
         assert one.schema.kind_of("x").is_numeric
+
+    def test_streaming_plan_cache_reuse(self, parquet_dir):
+        """A second streamed run of the SAME plan reuses the cached
+        jitted update: no Python retrace (r4: the streaming path joined
+        the plan cache; before, every profile retraced ~100 analyzers)."""
+        directory, _ = parquet_dir
+        plan = [Size(), Mean("x"), Completeness("x")]
+        with config.configure(device_cache_bytes=0):
+            first = AnalysisEngine(batch_size=1000)
+            AnalysisRunner.do_analysis_run(
+                Dataset.from_parquet(directory), plan, engine=first
+            )
+            second = AnalysisEngine(batch_size=1000)
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_parquet(directory), plan, engine=second
+            )
+        assert second.plan_cache_hit
+        assert second.trace_count == 0
+        assert ctx.metric(Size()).value.is_success
+
+    def test_streaming_phase_decomposition_recorded(self, parquet_dir):
+        """Every scan records its wall decomposition (host_wait / put /
+        dispatch / sync) as a scan_phases event (VERDICT r3 next #2)."""
+        directory, _ = parquet_dir
+        with config.configure(device_cache_bytes=0):
+            engine = AnalysisEngine(batch_size=1000)
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_parquet(directory), [Mean("x")], engine=engine
+            )
+        events = [
+            e
+            for e in ctx.run_metadata.events
+            if e.get("event") == "scan_phases"
+        ]
+        assert len(events) == 1
+        phases = events[0]
+        assert phases["mode"] == "streaming"
+        for key in ("host_wait_s", "put_s", "dispatch_s", "sync_s"):
+            assert phases[key] >= 0.0
+        # resident runs record the same decomposition
+        ctx2 = AnalysisRunner.do_analysis_run(
+            Dataset.from_parquet(directory), [Mean("x")]
+        )
+        modes = [
+            e["mode"]
+            for e in ctx2.run_metadata.events
+            if e.get("event") == "scan_phases"
+        ]
+        assert modes == ["resident"]
