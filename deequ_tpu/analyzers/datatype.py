@@ -19,6 +19,7 @@ import re
 from dataclasses import dataclass
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,6 +58,34 @@ def classify_string(value: str) -> int:
     return DataTypeHistogram.STRING
 
 
+def counts_from_code_presence(
+    codes: "jnp.ndarray",  # (C, B) int codes, -1 = null
+    valid: "jnp.ndarray",  # (C, B) validity (row mask pre-ANDed)
+    rows: "jnp.ndarray",  # (B,) kept-row mask
+    table: "jnp.ndarray",  # (C, D) class LUT per dictionary entry
+) -> "jnp.ndarray":
+    """(C, 6) type counts for dict-encoded columns WITHOUT per-row
+    gathers: per-code counts via a (C, D, B)->(C, D) compare-reduce
+    (VPU rate), then a class einsum over the LUT — vs per-row LUT
+    gather + scatter-add, both serialized-scatter-class on TPU
+    (~5-9x slower measured, docs/PERF.md). Null slot = kept rows
+    minus typed rows (a valid row always has a code; invalid/null
+    rows match no dictionary slot). The single-analyzer and stacked
+    group paths BOTH call this — their states max-merge, so the math
+    must stay single-sourced."""
+    D = table.shape[1]
+    d = jnp.arange(D, dtype=jnp.int32)
+    cnt = (
+        (codes.astype(jnp.int32)[:, None, :] == d[None, :, None])
+        & valid[:, None, :]
+    ).sum(axis=2, dtype=jnp.int32)  # (C, D)
+    onehot = jax.nn.one_hot(table, 6, dtype=jnp.int32)
+    counts = jnp.einsum("cd,cdk->ck", cnt, onehot)
+    kept = rows.sum(dtype=jnp.int32)
+    nulls = kept - cnt.sum(axis=1, dtype=jnp.int32)
+    return counts.at[:, DataTypeHistogram.NULL].add(nulls)
+
+
 @dataclass(frozen=True)
 class DataType(ScanShareableAnalyzer):
     """Inferred-type histogram of a column (reference: DataType.scala)."""
@@ -83,6 +112,10 @@ class DataType(ScanShareableAnalyzer):
         where_fn, _ = _compile_where(self.where, dataset)
         col = self.column
         kind = dataset.schema.kind_of(col)
+        # the presence fast path shares ONE implementation with the
+        # stacked group builder (counts_from_code_presence below):
+        # the two produce merge-compatible states, so the math must
+        # stay single-sourced
 
         if kind == Kind.STRING:
             from deequ_tpu.analyzers.base import pad_pow2
@@ -101,10 +134,22 @@ class DataType(ScanShareableAnalyzer):
             def update(
                 state: DataTypeHistogram, batch, consts
             ) -> DataTypeHistogram:
+                from deequ_tpu.sketches.hll import PRESENCE_DICT_CAP
+
                 table = consts["lut"]
                 rows = _row_mask(batch, where_fn)
                 valid = batch[f"{col}::mask"] & rows
                 codes = batch[f"{col}::codes"]
+                if table.shape[0] <= PRESENCE_DICT_CAP:
+                    counts = counts_from_code_presence(
+                        codes[None, :],
+                        valid[None, :],
+                        rows,
+                        table[None, :],
+                    )[0]
+                    return DataTypeHistogram(
+                        state.counts + counts.astype(jnp.int64)
+                    )
                 bucket = table[jnp.clip(codes, 0, table.shape[0] - 1)]
                 bucket = jnp.where(valid, bucket, DataTypeHistogram.NULL)
                 bucket = jnp.where(rows, bucket, 5)  # padding -> reserved

@@ -69,23 +69,34 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             )
             consts = {"h1": pad_pow2(lut1_host), "h2": pad_pow2(lut2_host)}
 
-            def hashes_of(batch, c):
+            def registers_of(batch, c, mask):
                 lut1, lut2 = c["h1"], c["h2"]
+                if lut1.shape[0] <= hll.PRESENCE_DICT_CAP:
+                    # small dictionary: presence compare-reduce beats
+                    # the per-row gather+scatter (sketches/hll.py)
+                    return hll.registers_from_code_presence(
+                        batch[f"{col}::codes"][None, :],
+                        mask[None, :],
+                        lut1[None, :],
+                        lut2[None, :],
+                    )[0]
                 codes = jnp.clip(
                     batch[f"{col}::codes"], 0, lut1.shape[0] - 1
                 )
-                return lut1[codes], lut2[codes]
+                return hll.registers_from_hash_pair(
+                    lut1[codes], lut2[codes], mask
+                )
 
         else:
             consts = None
 
-            def hashes_of(batch, c):
-                return hll.hash_pair_numeric(batch[f"{col}::values"])
+            def registers_of(batch, c, mask):
+                h1, h2 = hll.hash_pair_numeric(batch[f"{col}::values"])
+                return hll.registers_from_hash_pair(h1, h2, mask)
 
         def update(state: ApproxCountDistinctState, batch, consts_in=None):
             mask = batch[f"{col}::mask"] & _row_mask(batch, where_fn)
-            h1, h2 = hashes_of(batch, consts_in)
-            regs = hll.registers_from_hash_pair(h1, h2, mask)
+            regs = registers_of(batch, consts_in, mask)
             return ApproxCountDistinctState(
                 jnp.maximum(state.registers, regs)
             )

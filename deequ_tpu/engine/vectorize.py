@@ -371,13 +371,24 @@ def _build_hll_group(
                 [batch[f"{c}::codes"] for c in columns]
             ).astype(jnp.int32)
             lut1, lut2 = consts_in["h1"], consts_in["h2"]
-            codes = jnp.clip(codes, 0, lut1.shape[1] - 1)
-            h1 = jnp.take_along_axis(lut1, codes, axis=1)
-            h2 = jnp.take_along_axis(lut2, codes, axis=1)
+            if lut1.shape[1] <= hll.PRESENCE_DICT_CAP:
+                # small dictionaries: presence compare-reduce + one
+                # D-element scatter — bit-identical registers, no
+                # per-row scatter (the measured throughput wall)
+                regs = hll.registers_from_code_presence(
+                    codes, masks, lut1, lut2
+                )
+            else:
+                codes = jnp.clip(codes, 0, lut1.shape[1] - 1)
+                h1 = jnp.take_along_axis(lut1, codes, axis=1)
+                h2 = jnp.take_along_axis(lut2, codes, axis=1)
+                regs = hll.registers_from_hash_pair_stacked(
+                    h1, h2, masks
+                )
         else:
             x = jnp.stack([batch[f"{c}::values"] for c in columns])
             h1, h2 = hll.hash_pair_numeric(x)
-        regs = hll.registers_from_hash_pair_stacked(h1, h2, masks)
+            regs = hll.registers_from_hash_pair_stacked(h1, h2, masks)
         return S.ApproxCountDistinctState(
             jnp.maximum(state.registers, regs)
         )
@@ -553,6 +564,8 @@ def _build_datatype_group(
         return {"counts": np.zeros((C, 6), dtype=np.int64)}
 
     def update(state, batch, consts_in):
+        from deequ_tpu.sketches.hll import PRESENCE_DICT_CAP
+
         table = consts_in["lut"]
         rows = _row_mask(batch, where_fn)
         masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
@@ -560,18 +573,29 @@ def _build_datatype_group(
         codes = jnp.stack(
             [batch[f"{c}::codes"] for c in columns]
         ).astype(jnp.int32)
-        codes = jnp.clip(codes, 0, table.shape[1] - 1)
-        bucket = jnp.take_along_axis(table, codes, axis=1)
-        bucket = jnp.where(valid, bucket, S.DataTypeHistogram.NULL)
-        bucket = jnp.where(rows[None, :], bucket, 6)  # padding slot
-        col_ids = jax.lax.broadcasted_iota(jnp.int32, bucket.shape, 0)
-        flat = (col_ids * 8 + bucket).ravel()
-        counts = (
-            jnp.zeros(C * 8, dtype=jnp.int32)
-            .at[flat]
-            .add(1)
-            .reshape(C, 8)[:, :6]
-        )
+        if table.shape[1] <= PRESENCE_DICT_CAP:
+            # shared single-source implementation — see
+            # analyzers/datatype.py counts_from_code_presence
+            from deequ_tpu.analyzers.datatype import (
+                counts_from_code_presence,
+            )
+
+            counts = counts_from_code_presence(codes, valid, rows, table)
+        else:
+            codes = jnp.clip(codes, 0, table.shape[1] - 1)
+            bucket = jnp.take_along_axis(table, codes, axis=1)
+            bucket = jnp.where(valid, bucket, S.DataTypeHistogram.NULL)
+            bucket = jnp.where(rows[None, :], bucket, 6)  # padding slot
+            col_ids = jax.lax.broadcasted_iota(
+                jnp.int32, bucket.shape, 0
+            )
+            flat = (col_ids * 8 + bucket).ravel()
+            counts = (
+                jnp.zeros(C * 8, dtype=jnp.int32)
+                .at[flat]
+                .add(1)
+                .reshape(C, 8)[:, :6]
+            )
         return {"counts": state["counts"] + counts.astype(jnp.int64)}
 
     def merge(a, b):

@@ -150,6 +150,37 @@ def registers_from_hash_pair_stacked(
     )
 
 
+# dict sizes up to this use the presence path (measured on v5e: the
+# compare-reduce beats the per-row gather+scatter at every D tested up
+# to 4096 — 261ms -> ~0ms at D=64, 261ms -> 57ms at D=4096 for a
+# (4, 2^21) block; crossover extrapolates to D ~ 16k. docs/PERF.md.)
+PRESENCE_DICT_CAP = 4096
+
+
+def registers_from_code_presence(
+    codes: jnp.ndarray,  # (C, B) int codes, -1 = null
+    mask: jnp.ndarray,  # (C, B) validity (row mask pre-ANDed)
+    lut1: jnp.ndarray,  # (C, D) u32 per-dictionary-entry hashes
+    lut2: jnp.ndarray,
+) -> jnp.ndarray:
+    """Registers for dict-encoded columns WITHOUT touching rows with a
+    scatter: a register's value is the max rho over the DISTINCT values
+    present, so scattering each dictionary entry once, masked by
+    whether its code occurs in the batch, yields bit-identical
+    registers to scattering every row (max over duplicates ==
+    single occurrence). Presence is a (C, D, B)->(C, D) compare-reduce
+    the VPU eats at full rate, vs one serialized scatter element per
+    ROW (~145M elem/s measured) on the per-row path. Null codes (-1)
+    match no dictionary slot and vanish."""
+    D = lut1.shape[1]
+    d = jnp.arange(D, dtype=jnp.int32)
+    present = (
+        (codes.astype(jnp.int32)[:, None, :] == d[None, :, None])
+        & mask[:, None, :]
+    ).any(axis=2)
+    return registers_from_hash_pair_stacked(lut1, lut2, present)
+
+
 _Q = 32  # h2 supplies 32 bits => register ranks 0..Q+1
 
 

@@ -161,3 +161,72 @@ def test_group_states_persist_and_merge(ds, tmp_path):
                 )
         elif isinstance(m, float):
             assert m == pytest.approx(u, rel=rel), repr(analyzer)
+
+
+def test_presence_path_equals_gather_path(ds, monkeypatch):
+    """For dict-encoded columns the presence compare-reduce path (small
+    dictionaries) must produce bit-identical HLL registers and DataType
+    counts to the per-row gather+scatter path (r4 perf work — the two
+    share states/merge, so divergence would corrupt max-merges).
+
+    The plan runs TWO string columns per family so the STACKED group
+    builders' presence branches execute (single-member groups demote to
+    the single-analyzer builders), plus a where-variant that stays a
+    single: both implementations are pinned against the gather path."""
+    import pyarrow as pa
+
+    from deequ_tpu.engine import scan as scan_mod
+    from deequ_tpu.sketches import hll as hll_mod
+
+    rng = np.random.default_rng(7)
+    n = 4000
+    two_strings = Dataset.from_arrow(
+        pa.table(
+            {
+                "s1": pa.array(
+                    np.resize(
+                        np.array(
+                            ["ab", "c", None, "12", "3.5", "true"],
+                            dtype=object,
+                        ),
+                        n,
+                    )
+                ),
+                "s2": pa.array(
+                    rng.choice(["x", "7", "2.5", "false", "yy"], n)
+                ),
+                "k": pa.array(rng.integers(0, 500, n, dtype=np.int64)),
+            }
+        )
+    )
+    plan = [
+        ApproxCountDistinct("s1"),
+        ApproxCountDistinct("s2"),
+        DataType("s1"),
+        DataType("s2"),
+        ApproxCountDistinct("s1", where="k > 100"),
+    ]
+
+    def run():
+        scan_mod._PLAN_CACHE.clear()  # cached closures pin the old path
+        units, _ = plan_scan_units(two_strings, plan)
+        ctx = AnalysisRunner.do_analysis_run(two_strings, plan)
+        out = {}
+        for a in plan:
+            m = ctx.metric(a)
+            assert m.value.is_success, (a, m.value)
+            v = m.value.get()
+            out[repr(a)] = (
+                {k: d.absolute for k, d in v.values.items()}
+                if hasattr(v, "values")
+                else v
+            )
+        return out, len(units)
+
+    fast, n_units = run()
+    # the two-column families must actually have grouped (stacked path)
+    assert n_units == 3  # hll(s1,s2) + datatype(s1,s2) + where-single
+    monkeypatch.setattr(hll_mod, "PRESENCE_DICT_CAP", 0)  # force gather
+    slow, _ = run()
+    scan_mod._PLAN_CACHE.clear()
+    assert fast == slow
