@@ -152,7 +152,19 @@ class FrequenciesAndNumRows:
 
     def top_groups(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """(first-column key values, counts) of the k most frequent
-        groups, count-descending (Histogram's detail bins)."""
+        groups, count-descending (Histogram's detail bins).
+
+        Tie-break divergence (documented, ADVICE r3): among groups with
+        EQUAL counts at the k-boundary, this path keeps first-seen
+        order (stable argsort) while the device spill path keeps
+        ascending packed-key order (lax.top_k over sorted segments) —
+        the same data can select different boundary bins depending on
+        which path ran. Counts, ratios, and every derived metric are
+        identical; only WHICH of the equal-count bins beyond the cap
+        survive differs. A canonical cross-path tie order would need a
+        type-aware secondary sort (numeric vs code vs lexicographic)
+        on both paths for marginal value; callers needing stability
+        should raise max_detail_bins above the distinct count."""
         order = np.argsort(-self.counts, kind="stable")[:k]
         return self.keys[order, 0], self.counts[order]
 

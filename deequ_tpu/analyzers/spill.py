@@ -195,6 +195,10 @@ def _finalize_fn():
 
 @functools.partial(jax.jit, static_argnums=(3,))
 def _topk_fn(counts, group_keys, num_segments, k):
+    # equal-count ties at the k-boundary resolve in ascending
+    # PACKED-KEY order here (segments are key-sorted) vs first-seen
+    # order on the dense/Arrow path — a documented divergence; see
+    # FrequenciesAndNumRows.top_groups (ADVICE r3)
     in_range = (
         jnp.arange(counts.shape[0], dtype=jnp.int32) < num_segments
     )

@@ -602,19 +602,25 @@ class Dataset:
         requests: Sequence[ColumnRequest],
         batch_size: int,
         chunk_batches: int = 1,
+        derive_lengths: bool = True,
     ) -> int:
         """Upper-bound device bytes for the resident scan path (padded
         to whole chunks; all-valid masks cost nothing — they alias the
         synthesized row mask; derived string lengths pin their codes
-        chunks too)."""
+        chunks too). ``derive_lengths`` mirrors device_scan_chunks'
+        ``sharding is None`` gate: under explicit sharding lengths ship
+        directly, so the extra codes chunks must NOT be counted or
+        meshed scans over-estimate and wrongly reject the resident
+        path / over-evict (ADVICE r3)."""
         _, n_chunks = self._chunk_geometry(batch_size, chunk_batches)
         padded = n_chunks * chunk_batches * batch_size
         keys = self._dedup_requests(requests)
         per_row = 1  # synthesized row mask
         for r in keys.values():
             per_row += self._request_row_bytes(r)
-        for r in self._derived_length_codes(keys):
-            per_row += self._request_row_bytes(r)
+        if derive_lengths:
+            for r in self._derived_length_codes(keys):
+                per_row += self._request_row_bytes(r)
         return padded * per_row
 
     def _chunk_geometry(
@@ -642,8 +648,10 @@ class Dataset:
         chunk_rows = chunk_batches * batch_size
         keys = self._dedup_requests(requests)
         counted = dict(keys)
-        for r in self._derived_length_codes(keys):
-            counted.setdefault(r.key, r)
+        if shard_key is None:  # derived lengths only ride the
+            # unsharded path (device_scan_chunks gates on sharding)
+            for r in self._derived_length_codes(keys):
+                counted.setdefault(r.key, r)
         total = 0
         for ci in range(n_chunks):
             if (

@@ -75,6 +75,18 @@ class TableMetricsRepository(MetricsRepository):
         self._path = path
         self._last_seq = 0
         os.makedirs(path, exist_ok=True)
+        # sweep stale temp files from crashed writers (reads already
+        # ignore them; this bounds disk growth). One hour is far past
+        # any live write->rename window, so racing writers are safe.
+        cutoff = time.time() - 3600
+        for f in os.listdir(path):
+            if f.startswith(".") and f.endswith(".tmp"):
+                full = os.path.join(path, f)
+                try:
+                    if os.path.getmtime(full) < cutoff:
+                        os.remove(full)
+                except OSError:
+                    pass  # another sweeper won the race
 
     def _next_seq(self) -> int:
         self._last_seq = max(time.time_ns(), self._last_seq + 1)
@@ -92,14 +104,28 @@ class TableMetricsRepository(MetricsRepository):
             },
             schema=_SCHEMA,
         )
-        # unique filename: appends never clobber (multi-writer safe)
+        # unique filename: appends never clobber (multi-writer safe);
+        # write to a dotted temp name and rename into place so a
+        # concurrent reader's scan never opens a half-written file —
+        # rename is atomic on POSIX, and _scan only selects *.parquet
+        # (the temp name has no such suffix) (ADVICE r3 medium)
         name = f"{key.dataset_date}-{uuid.uuid4().hex}.parquet"
-        pq.write_table(table, os.path.join(self._path, name))
+        final_path = os.path.join(self._path, name)
+        tmp_path = os.path.join(self._path, f".{name}.tmp")
+        pq.write_table(table, tmp_path)
+        os.rename(tmp_path, final_path)
 
     def _scan(self, filter_expr=None) -> List[AnalysisResult]:
-        if not os.listdir(self._path):
+        # explicit *.parquet selection: in-flight .tmp files and any
+        # stray non-parquet file in the directory must not break loads
+        files = sorted(
+            os.path.join(self._path, f)
+            for f in os.listdir(self._path)
+            if f.endswith(".parquet")
+        )
+        if not files:
             return []
-        dataset = pads.dataset(self._path, format="parquet")
+        dataset = pads.dataset(files, format="parquet")
         table = dataset.to_table(
             columns=["result_key", "seq", "serialized_context"],
             filter=filter_expr,

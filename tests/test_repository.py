@@ -241,6 +241,25 @@ class TestTableRepository:
             len(reader.load().with_tag_values({"env": "prod"}).get()) == 2
         )
 
+    def test_stray_files_and_tmp_writes_ignored(self, context, tmp_path):
+        """Loads select *.parquet only: in-flight .tmp files (the
+        atomic-rename window) and stray non-parquet files must not
+        break or pollute reads (ADVICE r3 medium)."""
+        from deequ_tpu.repository.table import TableMetricsRepository
+
+        path = os.path.join(tmp_path, "tbl")
+        repo = TableMetricsRepository(path)
+        repo.save(AnalysisResult(ResultKey.of(1, {}), context))
+        # simulate a concurrent writer mid-save + unrelated junk
+        with open(os.path.join(path, ".inflight.parquet.tmp"), "wb") as f:
+            f.write(b"partial parquet bytes")
+        with open(os.path.join(path, "README.txt"), "w") as f:
+            f.write("not a parquet file")
+        reader = TableMetricsRepository(path)
+        got = reader.load().get()
+        assert len(got) == 1
+        assert reader.load_by_key(ResultKey.of(1, {})) is not None
+
 
 class TestConcurrency:
     """SURVEY §5.2: the reference's only shared mutable state is the
