@@ -93,16 +93,29 @@ def _msum(x, mask):
     return jnp.sum(jnp.where(mask, x, 0).astype(_F64)).astype(acc)
 
 
-def _mmin(x, mask):
-    """Masked min: elementwise native, scalar always f64 — min/max has
-    no accumulation-error concern, and f64 is exact for f32 inputs and
-    ints up to 2^53 (the reference's double semantics). A fixed result
-    dtype also keeps the lax.scan carry stable across column types."""
+def _mmin(x, mask, axis=None):
+    """Masked min under Spark's ordering: NaN ranks above every value,
+    so NaN values lose to any real value and win only when ALL masked
+    values are NaN (SURVEY.md §2.2; pinned by tests/goldens). Scalar
+    always f64 — min/max has no accumulation-error concern, and f64 is
+    exact for f32 inputs and ints up to 2^53 (the reference's double
+    semantics). A fixed result dtype also keeps the lax.scan carry
+    stable across column types."""
     if jnp.issubdtype(x.dtype, jnp.floating):
-        neutral = jnp.array(jnp.inf, x.dtype)
-    else:
-        neutral = jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
-    return jnp.min(jnp.where(mask, x, neutral)).astype(_F64)
+        # no real (non-NaN) contribution -> NaN, the nan_largest_min
+        # IDENTITY (states.MinState): an empty batch must not emit
+        # +inf, which would beat a later all-NaN batch's NaN in the
+        # carry merge. The count guard keeps identity NaN from ever
+        # surfacing for truly empty columns.
+        real = mask & ~jnp.isnan(x)
+        m = jnp.min(
+            jnp.where(real, x, jnp.array(jnp.inf, x.dtype)), axis=axis
+        ).astype(_F64)
+        return jnp.where(
+            jnp.any(real, axis=axis), m, jnp.array(jnp.nan, _F64)
+        )
+    neutral = jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
+    return jnp.min(jnp.where(mask, x, neutral), axis=axis).astype(_F64)
 
 
 def _mmax(x, mask):
@@ -431,7 +444,7 @@ class Minimum(_NumericColumnAnalyzer):
         def update(state: S.MinState, batch) -> S.MinState:
             mask = _col_mask(batch, col, where_fn)
             return S.MinState(
-                jnp.minimum(
+                S.nan_largest_min(
                     state.min_value, _mmin(batch[f"{col}::values"], mask)
                 ),
                 state.count + _mcount(mask),
@@ -444,8 +457,12 @@ class Minimum(_NumericColumnAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException("Empty state for analyzer Minimum.")
             )
+        # -0.0 normalizes to 0.0 (Spark's NormalizeFloatingNumbers; also
+        # backend-independent — TPU min lowering loses the -0.0 sign
+        # where CPU keeps it). Host-side add: XLA would fold it away.
         return DoubleMetric.success(
-            self.entity, "Minimum", self.instance, float(state.min_value)
+            self.entity, "Minimum", self.instance,
+            float(state.min_value) + 0.0,
         )
 
 
@@ -477,7 +494,8 @@ class Maximum(_NumericColumnAnalyzer):
                 EmptyStateException("Empty state for analyzer Maximum.")
             )
         return DoubleMetric.success(
-            self.entity, "Maximum", self.instance, float(state.max_value)
+            self.entity, "Maximum", self.instance,
+            float(state.max_value) + 0.0,  # -0.0 -> 0.0, see Minimum
         )
 
 
@@ -515,7 +533,10 @@ class MinLength(_LengthAnalyzer):
         def update(state: S.MinState, batch) -> S.MinState:
             mask = _col_mask(batch, col, where_fn)
             return S.MinState(
-                jnp.minimum(
+                # nan_largest_min, NOT jnp.minimum: the carry identity
+                # is NaN (states.MinState), which plain minimum would
+                # propagate over every real length
+                S.nan_largest_min(
                     state.min_value, _mmin(batch[f"{col}::lengths"], mask)
                 ),
                 state.count + _mcount(mask),

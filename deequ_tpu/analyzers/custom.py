@@ -79,19 +79,25 @@ class CustomSqlState(NamedTuple):
 
     @staticmethod
     def identity(k: int) -> "CustomSqlState":
+        # min identity is NaN, not +inf: under the Spark ordering
+        # (NaN largest) NaN is the true identity of nan_largest_min —
+        # +inf would beat an all-NaN batch's NaN and surface as a
+        # bogus MIN() = inf (states.MinState has the same identity)
         return CustomSqlState(
             np.zeros(k, dtype=np.float64),
             np.zeros(k, dtype=np.int64),
-            np.full(k, np.inf, dtype=np.float64),
+            np.full(k, np.nan, dtype=np.float64),
             np.full(k, -np.inf, dtype=np.float64),
         )
 
     @staticmethod
     def merge(a: "CustomSqlState", b: "CustomSqlState") -> "CustomSqlState":
+        from deequ_tpu.analyzers.states import nan_largest_min
+
         return CustomSqlState(
             a.sums + b.sums,
             a.counts + b.counts,
-            jnp.minimum(a.mins, b.mins),
+            nan_largest_min(a.mins, b.mins),
             jnp.maximum(a.maxs, b.maxs),
         )
 

@@ -593,9 +593,33 @@ def _grouped_to_frequencies(
     )
 
 
+def _normalize_float_keys(table: pa.Table, columns: List[str]) -> pa.Table:
+    """Spark grouping-key normalization for float key columns:
+    -0.0 groups with 0.0 (+0.0 is the identity elsewhere; Arrow's
+    group_by already treats NaN == NaN). tests/goldens neg_zero."""
+    import pyarrow.compute as pc
+
+    for c in columns:
+        col = table.column(c)
+        if pa.types.is_dictionary(col.type) and pa.types.is_floating(
+            col.type.value_type
+        ):
+            # flatten pre-encoded float dictionaries: the dictionary
+            # itself may hold -0.0 and 0.0 as distinct entries
+            col = pc.cast(col, col.type.value_type)
+        if pa.types.is_floating(col.type):
+            table = table.set_column(
+                table.schema.get_field_index(c),
+                c,
+                pc.add(col, pa.scalar(0.0, col.type)),
+            )
+    return table
+
+
 def _frequencies_of_table(
     columns: List[str], table: pa.Table
 ) -> FrequenciesAndNumRows:
+    table = _normalize_float_keys(table, columns)
     grouped = table.group_by(columns).aggregate([([], "count_all")])
     return _grouped_to_frequencies(
         grouped, columns, "count_all", int(table.num_rows)
@@ -619,7 +643,9 @@ def _arrow_frequencies(
         parts: List[pa.Table] = []
         num_rows = 0
         for record_batch in dataset.record_batches(columns):
-            table = pa.Table.from_batches([record_batch])
+            table = _normalize_float_keys(
+                pa.Table.from_batches([record_batch]), columns
+            )
             if not plan.include_nulls:
                 non_null = np.zeros(table.num_rows, dtype=bool)
                 for c in columns:

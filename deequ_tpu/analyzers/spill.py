@@ -103,13 +103,24 @@ def _chunk_key_fn(key_kind: str, include_nulls: bool):
             bits = jnp.where(
                 jnp.isnan(x), jnp.uint32(0x7FC00000), bits
             )
+            # -0.0 groups with 0.0 (Spark key normalization; goldens
+            # neg_zero) — mapped at the BIT level because XLA's
+            # simplifier folds the `x + 0.0` formulation away
+            bits = jnp.where(
+                bits == jnp.uint32(0x80000000), jnp.uint32(0), bits
+            )
             keys = bits.astype(jnp.uint64)
         elif key_kind == "f64":
             x = values.astype(jnp.float64)
             bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
-            keys = jnp.where(
+            bits = jnp.where(
                 jnp.isnan(x),
                 jnp.uint64(0x7FF8000000000000),
+                bits,
+            )
+            keys = jnp.where(
+                bits == jnp.uint64(0x8000000000000000),
+                jnp.uint64(0),
                 bits,
             )
         else:

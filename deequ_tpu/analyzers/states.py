@@ -37,6 +37,18 @@ def _iacc(value: int = 0):
     return np.int64(value)
 
 
+def nan_largest_min(a, b):
+    """Min under Spark's ordering, where NaN ranks ABOVE every value
+    including +inf (SURVEY.md §2.2 numeric semantics): NaN loses to any
+    non-NaN operand; min(NaN, NaN) = NaN. A plain ``jnp.minimum``
+    propagates NaN, which would let one all-NaN shard poison a merged
+    Minimum. The MAX side needs no counterpart — NaN-propagating
+    ``jnp.maximum`` IS Spark's max (NaN is the largest value)."""
+    return jnp.where(
+        jnp.isnan(a), b, jnp.where(jnp.isnan(b), a, jnp.minimum(a, b))
+    )
+
+
 class NumMatches(NamedTuple):
     num_matches: jnp.ndarray  # int64 scalar
 
@@ -103,12 +115,17 @@ class MinState(NamedTuple):
     @staticmethod
     def identity() -> "MinState":
         # always f64: min/max carries no accumulation error (see
-        # basic._mmin) and must not round large ints
-        return MinState(np.float64(np.inf), _iacc(0))
+        # basic._mmin) and must not round large ints. NaN, not +inf:
+        # under the Spark ordering NaN is nan_largest_min's identity —
+        # +inf would beat an all-NaN column's NaN and surface as a
+        # bogus min of inf. count==0 guards the truly-empty case.
+        return MinState(np.float64(np.nan), _iacc(0))
 
     @staticmethod
     def merge(a: "MinState", b: "MinState") -> "MinState":
-        return MinState(jnp.minimum(a.min_value, b.min_value), a.count + b.count)
+        return MinState(
+            nan_largest_min(a.min_value, b.min_value), a.count + b.count
+        )
 
 
 class MaxState(NamedTuple):

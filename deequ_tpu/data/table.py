@@ -399,6 +399,19 @@ class Dataset:
 
     def _materialize_codes(self, column: str) -> None:
         arr = self._table.column(column)
+        if pa.types.is_dictionary(arr.type) and pa.types.is_floating(
+            arr.type.value_type
+        ):
+            # a pre-encoded float dictionary may hold BOTH -0.0 and
+            # 0.0 (or duplicate NaNs) as distinct entries — flatten so
+            # the normalization below can re-unify the codes
+            arr = pc.cast(arr, arr.type.value_type)
+        if pa.types.is_floating(arr.type):
+            # Spark normalizes -0.0 to 0.0 in grouping keys (and NaN ==
+            # NaN — Arrow's dictionary_encode already does that part);
+            # +0.0 is the identity for every other value.
+            # tests/goldens neg_zero pins this.
+            arr = pc.add(arr, pa.scalar(0.0, arr.type))
         if pa.types.is_dictionary(arr.type):
             dict_arr = arr.combine_chunks()
         else:

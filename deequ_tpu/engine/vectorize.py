@@ -164,8 +164,8 @@ def _build_stats_group(
         state = {"n": np.zeros(C, dtype=np.int64)}
         if "sum" in needs:
             state["sum"] = np.zeros(C, dtype=np.dtype(acc))
-        if "min" in needs:
-            state["min"] = np.full(C, np.inf, dtype=np.float64)
+        if "min" in needs:  # NaN = nan_largest_min identity (states.py)
+            state["min"] = np.full(C, np.nan, dtype=np.float64)
         if "max" in needs:
             state["max"] = np.full(C, -np.inf, dtype=np.float64)
         if "welford" in needs:
@@ -195,15 +195,11 @@ def _build_stats_group(
                     jnp.where(masks, x, 0).astype(_F64), axis=1
                 ).astype(acc)
             new["sum"] = state["sum"] + sum_b
-        if "min" in needs:  # mirrors basic._mmin
-            neutral = (
-                jnp.array(jnp.inf, x.dtype)
-                if is_float
-                else jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
-            )
-            new["min"] = jnp.minimum(
-                state["min"],
-                jnp.min(jnp.where(masks, x, neutral), axis=1).astype(_F64),
+        if "min" in needs:  # mirrors basic._mmin (NaN-largest ordering)
+            from deequ_tpu.analyzers.basic import _mmin
+
+            new["min"] = S.nan_largest_min(
+                state["min"], _mmin(x, masks, axis=1)
             )
         if "max" in needs:  # mirrors basic._mmax
             neutral = (
@@ -241,7 +237,7 @@ def _build_stats_group(
         if "sum" in needs:
             out["sum"] = a["sum"] + b["sum"]
         if "min" in needs:
-            out["min"] = jnp.minimum(a["min"], b["min"])
+            out["min"] = S.nan_largest_min(a["min"], b["min"])
         if "max" in needs:
             out["max"] = jnp.maximum(a["max"], b["max"])
         if "welford" in needs:

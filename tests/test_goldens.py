@@ -1,0 +1,73 @@
+"""Exactness-golden loader: every case in tests/goldens/*.json must
+reproduce its frozen expected value EXACTLY (SURVEY.md §7 hard part 4
+— "tests must pin exact values vs reference semantics … else every
+metric silently drifts").
+
+The golden file is the semantic contract: nulls, literal NaN, -0.0,
+COUNT(col) vs COUNT(*), empty tables, single rows, all-null columns.
+Regenerating it is a deliberate act (``python tools/make_goldens.py``)
+whose diff must be reviewed — a failure here means the implementation
+drifted, not that the golden needs refreshing.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from deequ_tpu import Dataset  # noqa: E402
+from tools import goldens_spec as spec  # noqa: E402
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "goldens", "core_v1.json"
+)
+
+
+def _golden():
+    with open(GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def _case_id(case):
+    a = dict(case["analyzer"])
+    t = a.pop("type")
+    rest = ",".join(f"{k}={v}" for k, v in sorted(a.items()))
+    return f"{case['fixture']}-{t}({rest})"
+
+
+GOLDEN = _golden()
+
+
+def test_golden_version_and_coverage():
+    assert GOLDEN["version"] == spec.GOLDEN_VERSION
+    # the frozen file covers exactly the spec's cases — a spec case
+    # without a frozen value is an unpinned semantic
+    frozen = {
+        (c["fixture"], json.dumps(c["analyzer"], sort_keys=True))
+        for c in GOLDEN["cases"]
+    }
+    current = {
+        (f, json.dumps(s, sort_keys=True)) for f, s in spec.cases()
+    }
+    assert frozen == current, (
+        "spec cases and frozen golden diverge — regenerate via "
+        "tools/make_goldens.py and review the diff"
+    )
+
+
+@pytest.mark.parametrize(
+    "case", GOLDEN["cases"], ids=[_case_id(c) for c in GOLDEN["cases"]]
+)
+def test_golden_case(case):
+    tables = spec.fixtures()
+    ds = Dataset.from_arrow(tables[case["fixture"]])
+    got = spec.run_case(ds, case["analyzer"])
+    assert got == case["expect"], (
+        f"semantic drift on {_case_id(case)}: frozen="
+        f"{case['expect']} got={got}"
+    )

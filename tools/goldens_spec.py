@@ -1,0 +1,335 @@
+"""Exactness-golden SPEC: the fixtures and analyzer cases whose exact
+metric values are frozen in ``tests/goldens/*.json``.
+
+Purpose (SURVEY.md §7 hard part 4): deequ's value semantics — null
+handling, NaN, -0.0, COUNT(col) vs COUNT(*), empty tables, single
+rows, all-null columns — must be PINNED as versioned expected-value
+files, so (a) any refactor that silently drifts a metric fails the
+loader test, and (b) the day ``/root/reference`` is populated, the
+frozen values can be diffed against the real reference's outputs
+case by case (``tools/recite_reference.py`` prints the checklist).
+
+The spec lives HERE (one module) and is imported by both the
+generator (``tools/make_goldens.py``) and the loader test
+(``tests/test_goldens.py``) — two copies would drift.
+
+Encoding notes:
+- floats serialize via ``encode_value`` (NaN/±inf as strings, -0.0
+  distinguished from +0.0 via the sign bit) so JSON round-trips are
+  exact;
+- each case expects either ``{"success": true, "value": ...}`` or
+  ``{"success": false, "error": "<ExceptionTypeName>"}`` — failures
+  ARE semantics (deequ returns failure metrics as values, never
+  throws; SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import numpy as np
+import pyarrow as pa
+
+GOLDEN_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# fixtures: name -> pyarrow table builder
+# ---------------------------------------------------------------------------
+
+
+def _t(d) -> pa.Table:
+    return pa.table(d)
+
+
+def fixtures() -> Dict[str, pa.Table]:
+    return {
+        # nulls vs values: COUNT(col)-style metrics see 3 of 5 rows
+        "nulls_basic": _t(
+            {
+                "x": pa.array([1.0, None, 3.0, None, 5.0], pa.float64()),
+                "s": pa.array(["a", "b", None, "b", "a"]),
+                "k": pa.array([1, 2, 3, 4, 5], pa.int64()),
+            }
+        ),
+        # literal NaN VALUES (not nulls): aggregate semantics must
+        # treat NaN as a present value (propagates into Mean/Sum like
+        # Spark's avg/sum over NaN; distinct from SQL NULL)
+        "nan_values": _t(
+            {
+                "x": pa.array(
+                    [1.0, float("nan"), 3.0], pa.float64()
+                ),
+            }
+        ),
+        # -0.0 vs +0.0: equal as numbers (SQL/IEEE ==), so
+        # distinctness-family must count ONE group; min/max NORMALIZE
+        # -0.0 to 0.0 (Spark's NormalizeFloatingNumbers — also
+        # backend-independent, the TPU min lowering drops the sign)
+        "neg_zero": _t(
+            {
+                "x": pa.array([-0.0, 0.0, -0.0], pa.float64()),
+            }
+        ),
+        # pre-encoded float dictionary holding BOTH zeros as distinct
+        # entries: normalization must re-unify the codes
+        "neg_zero_dict": pa.table(
+            {
+                "x": pa.array(
+                    [-0.0, 0.0, -0.0, 1.5], pa.float64()
+                ).dictionary_encode(),
+            }
+        ),
+        # ALL values are literal NaN (none null): Spark's ordering
+        # makes NaN the min AND max of an all-NaN column
+        "all_nan": _t(
+            {
+                "x": pa.array([float("nan")] * 3, pa.float64()),
+            }
+        ),
+        "empty": _t(
+            {
+                "x": pa.array([], pa.float64()),
+                "s": pa.array([], pa.string()),
+            }
+        ),
+        "single_row": _t(
+            {
+                "x": pa.array([42.5], pa.float64()),
+                "s": pa.array(["only"], pa.string()),
+            }
+        ),
+        "all_null": _t(
+            {
+                "x": pa.array([None, None, None], pa.float64()),
+                "s": pa.array([None, None, None], pa.string()),
+            }
+        ),
+        # COUNT(col) vs COUNT(*): where-filtered Size counts kept ROWS
+        # (null x included); Completeness counts non-null OF kept rows
+        "count_col_vs_star": _t(
+            {
+                "x": pa.array([1.0, None, 3.0, None], pa.float64()),
+                "grp": pa.array(["a", "a", "b", "b"]),
+            }
+        ),
+        # strings with padding-sensitive lengths + mixed types
+        "strings": _t(
+            {
+                "s": pa.array(["", "ab", None, "abcd", "ab"]),
+            }
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# cases: (fixture, analyzer-spec) pairs; analyzer specs are built by
+# the shared factory below so the generator and test construct the
+# EXACT same analyzer objects
+# ---------------------------------------------------------------------------
+
+
+def build_analyzer(spec: Dict[str, Any]):
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Compliance,
+        Correlation,
+        CountDistinct,
+        DataType,
+        Distinctness,
+        Entropy,
+        Maximum,
+        MaxLength,
+        Mean,
+        Minimum,
+        MinLength,
+        PatternMatch,
+        Size,
+        StandardDeviation,
+        Sum,
+        Uniqueness,
+        UniqueValueRatio,
+    )
+
+    kinds = {
+        "Size": lambda s: Size(where=s.get("where")),
+        "Completeness": lambda s: Completeness(
+            s["column"], where=s.get("where")
+        ),
+        "Mean": lambda s: Mean(s["column"], where=s.get("where")),
+        "Sum": lambda s: Sum(s["column"], where=s.get("where")),
+        "Minimum": lambda s: Minimum(s["column"], where=s.get("where")),
+        "Maximum": lambda s: Maximum(s["column"], where=s.get("where")),
+        "StandardDeviation": lambda s: StandardDeviation(
+            s["column"], where=s.get("where")
+        ),
+        "MinLength": lambda s: MinLength(s["column"]),
+        "MaxLength": lambda s: MaxLength(s["column"]),
+        "CountDistinct": lambda s: CountDistinct(s["columns"]),
+        "Distinctness": lambda s: Distinctness(s["columns"]),
+        "Uniqueness": lambda s: Uniqueness(s["columns"]),
+        "UniqueValueRatio": lambda s: UniqueValueRatio(s["columns"]),
+        "Entropy": lambda s: Entropy(s["column"]),
+        "Compliance": lambda s: Compliance(
+            s["instance"], s["predicate"], where=s.get("where")
+        ),
+        "PatternMatch": lambda s: PatternMatch(
+            s["column"], s["pattern"]
+        ),
+        "Correlation": lambda s: Correlation(s["first"], s["second"]),
+        "ApproxCountDistinct": lambda s: ApproxCountDistinct(
+            s["column"]
+        ),
+        "DataType": lambda s: DataType(s["column"]),
+    }
+    return kinds[spec["type"]](spec)
+
+
+def cases():
+    """(fixture_name, analyzer_spec) in a stable order."""
+    c = []
+
+    def add(fixture, **spec):
+        c.append((fixture, spec))
+
+    # nulls_basic — null handling of every aggregate family
+    for t in (
+        "Size", "Completeness", "Mean", "Sum", "Minimum", "Maximum",
+        "StandardDeviation", "ApproxCountDistinct",
+    ):
+        add("nulls_basic", type=t, column="x")
+    add("nulls_basic", type="Completeness", column="s")
+    add("nulls_basic", type="CountDistinct", columns=["s"])
+    add("nulls_basic", type="Distinctness", columns=["s"])
+    add("nulls_basic", type="Uniqueness", columns=["s"])
+    add("nulls_basic", type="UniqueValueRatio", columns=["s"])
+    add("nulls_basic", type="Entropy", column="s")
+    add("nulls_basic", type="Correlation", first="x", second="k")
+    add(
+        "nulls_basic",
+        type="Compliance",
+        instance="x big",
+        predicate="x >= 3",
+    )
+    # COUNT(col) vs COUNT(*): Size counts ROWS under where;
+    # Compliance's denominator is kept rows, null predicate rows
+    # count as non-compliant (SQL: NULL condition -> not true)
+    add("count_col_vs_star", type="Size")
+    add("count_col_vs_star", type="Size", where="grp = 'a'")
+    add("count_col_vs_star", type="Completeness", column="x")
+    add(
+        "count_col_vs_star",
+        type="Completeness",
+        column="x",
+        where="grp = 'a'",
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="x pos",
+        predicate="x > 0",
+    )
+    add("count_col_vs_star", type="Mean", column="x", where="grp = 'b'")
+    # NaN values
+    for t in ("Mean", "Sum", "Minimum", "Maximum", "Completeness"):
+        add("nan_values", type=t, column="x")
+    add("nan_values", type="CountDistinct", columns=["x"])
+    # -0.0
+    for t in ("Minimum", "Maximum", "Sum", "Mean"):
+        add("neg_zero", type=t, column="x")
+    add("neg_zero", type="CountDistinct", columns=["x"])
+    add("neg_zero", type="Distinctness", columns=["x"])
+    add("neg_zero_dict", type="CountDistinct", columns=["x"])
+    add("neg_zero_dict", type="Distinctness", columns=["x"])
+    add("neg_zero_dict", type="Minimum", column="x")
+    # all-NaN column: min/max both NaN (NaN ranks above +inf), never
+    # +inf (the identity must not leak; ADVICE via r4 code review)
+    for t in ("Minimum", "Maximum", "Mean", "Completeness"):
+        add("all_nan", type=t, column="x")
+    # empty table
+    for t in (
+        "Size", "Completeness", "Mean", "Sum", "Minimum", "Maximum",
+        "StandardDeviation", "ApproxCountDistinct",
+    ):
+        add("empty", type=t, column="x")
+    add("empty", type="CountDistinct", columns=["s"])
+    add("empty", type="Distinctness", columns=["s"])
+    add("empty", type="Entropy", column="s")
+    add("empty", type="MinLength", column="s")
+    # single row
+    for t in (
+        "Size", "Mean", "StandardDeviation", "Minimum", "Maximum",
+    ):
+        add("single_row", type=t, column="x")
+    add("single_row", type="Uniqueness", columns=["s"])
+    add("single_row", type="MinLength", column="s")
+    add("single_row", type="MaxLength", column="s")
+    # all-null column
+    for t in (
+        "Completeness", "Mean", "Sum", "Minimum", "Maximum",
+        "StandardDeviation", "ApproxCountDistinct",
+    ):
+        add("all_null", type=t, column="x")
+    add("all_null", type="CountDistinct", columns=["s"])
+    add("all_null", type="Distinctness", columns=["s"])
+    add("all_null", type="MinLength", column="s")
+    # strings: empty string vs null lengths; pattern over nulls
+    add("strings", type="MinLength", column="s")
+    add("strings", type="MaxLength", column="s")
+    add("strings", type="PatternMatch", column="s", pattern="^ab")
+    add("strings", type="Completeness", column="s")
+    add("strings", type="DataType", column="s")
+    return c
+
+
+# ---------------------------------------------------------------------------
+# exact value encoding
+# ---------------------------------------------------------------------------
+
+
+def encode_value(v: Any) -> Any:
+    """JSON-exact encoding: NaN/±inf as tagged strings; -0.0 kept
+    distinct from 0.0 via the sign bit; Distributions as dicts."""
+    if hasattr(v, "values") and hasattr(v, "number_of_bins"):
+        return {
+            "__distribution__": {
+                k: [dv.absolute, encode_value(dv.ratio)]
+                for k, dv in sorted(v.values.items())
+            }
+        }
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        if math.isnan(f):
+            return "__nan__"
+        if math.isinf(f):
+            return "__inf__" if f > 0 else "__-inf__"
+        if f == 0.0 and math.copysign(1.0, f) < 0:
+            return "__-0.0__"
+        return f
+    return v
+
+
+def run_case(dataset, spec) -> Dict[str, Any]:
+    """Execute one case; returns the JSON-ready outcome dict."""
+    from deequ_tpu.analyzers import AnalysisRunner
+
+    analyzer = build_analyzer(spec)
+    ctx = AnalysisRunner.do_analysis_run(dataset, [analyzer])
+    metric = ctx.metric(analyzer)
+    if metric.value.is_success:
+        return {
+            "success": True,
+            "value": encode_value(metric.value.get()),
+        }
+    exc = metric.value.exception  # property on Failure
+    # unwrap the wrapper to the ROOT cause type: the wrapper class is
+    # an implementation detail; the root type is the pinned semantic
+    cause = exc
+    while cause.__cause__ is not None:
+        cause = cause.__cause__
+    return {"success": False, "error": type(cause).__name__}

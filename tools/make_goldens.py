@@ -1,0 +1,100 @@
+"""Regenerate the exactness-golden pack (tests/goldens/core_v1.json).
+
+Run: ``python tools/make_goldens.py [--check]``
+
+``--check`` diffs the current implementation against the frozen file
+and exits non-zero on drift WITHOUT rewriting (what CI/the loader test
+does; regeneration is a DELIBERATE act — review the diff before
+committing a new golden, because the golden IS the semantic contract).
+
+Goldens always generate on the CPU backend so the frozen values are
+hardware-independent; tests/test_goldens.py additionally runs the
+default backend against the same file, pinning TPU == frozen-CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "goldens",
+    "core_v1.json",
+)
+
+
+def generate() -> dict:
+    from deequ_tpu import Dataset, config
+    from tools import goldens_spec as spec
+
+    tables = spec.fixtures()
+    out = {
+        "version": spec.GOLDEN_VERSION,
+        "provenance": (
+            "semantics reconstructed from SURVEY.md (reference mount "
+            "empty); regenerate deliberately via tools/make_goldens.py "
+            "and diff against the real reference when it populates"
+        ),
+        "cases": [],
+    }
+    with config.configure(engine="cpu"):
+        for fixture_name, analyzer_spec in spec.cases():
+            ds = Dataset.from_arrow(tables[fixture_name])
+            outcome = spec.run_case(ds, analyzer_spec)
+            out["cases"].append(
+                {
+                    "fixture": fixture_name,
+                    "analyzer": analyzer_spec,
+                    "expect": outcome,
+                }
+            )
+    return out
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    current = generate()
+    if check:
+        with open(GOLDEN_PATH) as f:
+            frozen = json.load(f)
+        drift = []
+        frozen_cases = {
+            (c["fixture"], json.dumps(c["analyzer"], sort_keys=True)): c[
+                "expect"
+            ]
+            for c in frozen["cases"]
+        }
+        for c in current["cases"]:
+            key = (c["fixture"], json.dumps(c["analyzer"], sort_keys=True))
+            want = frozen_cases.pop(key, None)
+            if want is None:
+                drift.append(f"NEW case (not frozen): {key}")
+            elif want != c["expect"]:
+                drift.append(
+                    f"DRIFT {key}: frozen={want} current={c['expect']}"
+                )
+        for key in frozen_cases:
+            drift.append(f"MISSING case (frozen but not run): {key}")
+        for line in drift:
+            print(line)
+        print(
+            f"{len(drift)} drift(s)"
+            if drift
+            else f"all {len(current['cases'])} cases match the golden"
+        )
+        return 1 if drift else 0
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(current, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(current['cases'])} cases to {GOLDEN_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

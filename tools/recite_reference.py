@@ -1,0 +1,115 @@
+"""Reference re-cite watch (run at round start).
+
+The reference mount ``/root/reference`` has been EMPTY every round so
+far (verified r1-r4), so every ``reference:``/``SURVEY.md`` citation in
+this repo is a reconstruction. The day the mount populates, every such
+citation must be re-verified against the real files, and the exactness
+goldens (tests/goldens/) must be diffed against the real reference's
+behavior.
+
+Run: ``python tools/recite_reference.py [--reference PATH]``
+
+- mount empty  -> prints the standing provenance note, exit 0
+- mount populated -> prints (a) the reference file inventory, (b) every
+  citation in deequ_tpu/**.py + SURVEY.md-derived docs with its source
+  location, as a re-verification checklist, and (c) the golden-pack
+  diff instructions; exit 1 so a round-start script loudly flags it
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# "reference:" docstring citations and explicit .scala paths
+_CITE = re.compile(
+    r"(reference[:\s].{0,120}?\.scala[^\s\)\"`]*|src/main/scala/[^\s\)\"`]+)",
+    re.IGNORECASE,
+)
+
+
+def scan_citations():
+    out = []
+    roots = [
+        os.path.join(REPO, "deequ_tpu"),
+        os.path.join(REPO, "docs"),
+        os.path.join(REPO, "tests"),
+    ]
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                if not name.endswith((".py", ".md")):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    with open(path, errors="replace") as f:
+                        for lineno, line in enumerate(f, 1):
+                            for m in _CITE.finditer(line):
+                                out.append(
+                                    (
+                                        os.path.relpath(path, REPO),
+                                        lineno,
+                                        m.group(0).strip(),
+                                    )
+                                )
+                except OSError:
+                    continue
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--reference", default="/root/reference")
+    args = parser.parse_args()
+
+    ref_files = []
+    if os.path.isdir(args.reference):
+        for dirpath, _dirs, files in os.walk(args.reference):
+            for name in files:
+                ref_files.append(
+                    os.path.relpath(
+                        os.path.join(dirpath, name), args.reference
+                    )
+                )
+
+    if not ref_files:
+        print(
+            f"reference mount {args.reference} is EMPTY (standing state "
+            "since r1): citations remain SURVEY.md reconstructions; "
+            "nothing to re-verify this round."
+        )
+        return 0
+
+    print(
+        f"REFERENCE MOUNT POPULATED: {len(ref_files)} files found. "
+        "Every citation below must be re-verified against the real "
+        "source, and file:line anchors added.\n"
+    )
+    print("== reference inventory (first 50) ==")
+    for f in sorted(ref_files)[:50]:
+        print(f"  {f}")
+    if len(ref_files) > 50:
+        print(f"  ... and {len(ref_files) - 50} more")
+
+    cites = scan_citations()
+    print(f"\n== {len(cites)} citations to re-verify ==")
+    for path, lineno, text in cites:
+        print(f"  {path}:{lineno}: {text}")
+
+    print(
+        "\n== exactness goldens ==\n"
+        "  Diff tests/goldens/*.json against the real reference's "
+        "outputs for the same fixtures (tools/goldens_spec.py defines "
+        "them); any mismatch is a semantic divergence to fix or "
+        "document. Then regenerate deliberately via "
+        "tools/make_goldens.py."
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
