@@ -311,7 +311,11 @@ class CustomSql(ScanShareableAnalyzer):
                             f"MIN({col}) over zero rows in CustomSql."
                         )
                     )
-                values[(func, col)] = float(np.asarray(state.mins)[i])
+                # -0.0 -> 0.0: same normalization as Minimum (backend-
+                # independent; basic.py documents why)
+                values[(func, col)] = (
+                    float(np.asarray(state.mins)[i]) + 0.0
+                )
             else:  # MAX
                 if count == 0:
                     return self.to_failure_metric(
@@ -319,7 +323,9 @@ class CustomSql(ScanShareableAnalyzer):
                             f"MAX({col}) over zero rows in CustomSql."
                         )
                     )
-                values[(func, col)] = float(np.asarray(state.maxs)[i])
+                values[(func, col)] = (
+                    float(np.asarray(state.maxs)[i]) + 0.0
+                )
         try:
             result = _finalize(node, values)
         except Exception as exc:  # noqa: BLE001

@@ -594,24 +594,17 @@ def _grouped_to_frequencies(
 
 
 def _normalize_float_keys(table: pa.Table, columns: List[str]) -> pa.Table:
-    """Spark grouping-key normalization for float key columns:
-    -0.0 groups with 0.0 (+0.0 is the identity elsewhere; Arrow's
-    group_by already treats NaN == NaN). tests/goldens neg_zero."""
-    import pyarrow.compute as pc
+    """Spark grouping-key normalization for float key columns (-0.0 ->
+    0.0, all NaN payloads -> one canonical NaN): the ONE shared rule,
+    data.table.normalize_float_grouping_keys. tests/goldens neg_zero."""
+    from deequ_tpu.data.table import normalize_float_grouping_keys
 
     for c in columns:
         col = table.column(c)
-        if pa.types.is_dictionary(col.type) and pa.types.is_floating(
-            col.type.value_type
-        ):
-            # flatten pre-encoded float dictionaries: the dictionary
-            # itself may hold -0.0 and 0.0 as distinct entries
-            col = pc.cast(col, col.type.value_type)
-        if pa.types.is_floating(col.type):
+        normalized = normalize_float_grouping_keys(col)
+        if normalized is not col:
             table = table.set_column(
-                table.schema.get_field_index(c),
-                c,
-                pc.add(col, pa.scalar(0.0, col.type)),
+                table.schema.get_field_index(c), c, normalized
             )
     return table
 

@@ -222,6 +222,35 @@ class Kind(enum.Enum):
         return self in (Kind.INTEGRAL, Kind.FRACTIONAL, Kind.BOOLEAN)
 
 
+def normalize_float_grouping_keys(arr):
+    """Spark grouping-key normalization for float columns, shared by
+    the dictionary/codes path (Dataset._materialize_codes) and the
+    Arrow group_by fallback (analyzers.grouping._normalize_float_keys):
+
+    - pre-encoded float dictionaries are flattened first (the
+      dictionary itself may hold -0.0 AND 0.0, or several NaN
+      payloads, as distinct entries);
+    - every NaN payload maps to the one canonical NaN — Arrow's
+      group_by/dictionary_encode treat DIFFERENT NaN bit patterns as
+      distinct keys (verified empirically), while Spark and the device
+      spill kernel (spill._chunk_key_fn) group all NaNs together;
+    - -0.0 maps to 0.0 via +0.0 (identity for every other value).
+
+    Non-float arrays pass through untouched. tests/goldens neg_zero /
+    nan fixtures pin the behavior."""
+    if pa.types.is_dictionary(arr.type) and pa.types.is_floating(
+        arr.type.value_type
+    ):
+        arr = pc.cast(arr, arr.type.value_type)
+    if not pa.types.is_floating(arr.type):
+        return arr
+    return pc.if_else(
+        pc.is_nan(arr),
+        pa.scalar(float("nan"), arr.type),
+        pc.add(arr, pa.scalar(0.0, arr.type)),
+    )
+
+
 def _kind_of(arrow_type: pa.DataType) -> Kind:
     if pa.types.is_boolean(arrow_type):
         return Kind.BOOLEAN
@@ -398,20 +427,7 @@ class Dataset:
         return self._dictionaries[column]
 
     def _materialize_codes(self, column: str) -> None:
-        arr = self._table.column(column)
-        if pa.types.is_dictionary(arr.type) and pa.types.is_floating(
-            arr.type.value_type
-        ):
-            # a pre-encoded float dictionary may hold BOTH -0.0 and
-            # 0.0 (or duplicate NaNs) as distinct entries — flatten so
-            # the normalization below can re-unify the codes
-            arr = pc.cast(arr, arr.type.value_type)
-        if pa.types.is_floating(arr.type):
-            # Spark normalizes -0.0 to 0.0 in grouping keys (and NaN ==
-            # NaN — Arrow's dictionary_encode already does that part);
-            # +0.0 is the identity for every other value.
-            # tests/goldens neg_zero pins this.
-            arr = pc.add(arr, pa.scalar(0.0, arr.type))
+        arr = normalize_float_grouping_keys(self._table.column(column))
         if pa.types.is_dictionary(arr.type):
             dict_arr = arr.combine_chunks()
         else:

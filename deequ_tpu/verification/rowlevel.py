@@ -3,14 +3,29 @@
 Reference: newer-upstream row-level results (SURVEY.md §2.2
 "FilteredRowOutcome", ``VerificationResult.rowLevelResultsAsDataFrame``):
 row-level-capable analyzers also emit a per-row boolean outcome column.
-Supported here: Completeness, Compliance (and every Check method that
-compiles to it: is_contained_in, is_non_negative, satisfies, ...),
-PatternMatch (and contains_email/url/...), Uniqueness. Rows excluded by
-a ``where`` filter count as passing (the reference's default
-FilteredRowOutcome is non-failing).
+
+Supported families:
+
+- **mask/predicate**: Completeness, Compliance (and every Check method
+  that compiles to it: is_contained_in, is_non_negative, satisfies,
+  ...), PatternMatch (and contains_email/url/...);
+- **grouping**: Uniqueness (a row passes iff its key occurs once);
+- **asserted-value** (r4, reference's RowLevelAssertedConstraint):
+  MinLength/MaxLength (per-row string length) and Minimum/Maximum
+  (per-row numeric value) apply the CONSTRAINT'S OWN assertion to each
+  row's value — e.g. ``has_min_length("s", lambda v: v >= 3)`` marks
+  exactly the too-short rows. Null rows pass (the reference's default
+  NullBehavior.Ignore; Completeness is the analyzer that flags nulls).
+
+Filtered-row semantics are configurable (reference:
+``AnalyzerOptions.filteredRow``): rows excluded by a ``where`` filter
+count as PASSING under the default ``filtered_row_outcome="true"``, or
+come back as SQL NULL under ``"null"`` (the outcome column is then a
+nullable boolean, matching the reference's NULLED FilteredRowOutcome).
 
 Outcomes are computed vectorized — device ops for predicate/mask work,
-one host ``np.unique`` pass for uniqueness — never per-row Python.
+one host ``np.unique`` pass for uniqueness, assertions evaluated once
+per UNIQUE value then gathered — never per-row Python.
 """
 
 from __future__ import annotations
@@ -22,7 +37,15 @@ import numpy as np
 import pyarrow as pa
 
 from deequ_tpu.analyzers.base import Analyzer
-from deequ_tpu.analyzers.basic import Completeness, Compliance, PatternMatch
+from deequ_tpu.analyzers.basic import (
+    Completeness,
+    Compliance,
+    Maximum,
+    MaxLength,
+    Minimum,
+    MinLength,
+    PatternMatch,
+)
 from deequ_tpu.analyzers.grouping import Uniqueness
 from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
 from deequ_tpu.constraints.constraint import (
@@ -53,8 +76,60 @@ def _where_pass(where: Optional[str], data: Dataset) -> Optional[np.ndarray]:
     return ~np.asarray(jax.device_get(pred.complies(batch)), dtype=bool)
 
 
-def _outcome_for(analyzer: Analyzer, data: Dataset) -> Optional[np.ndarray]:
-    if isinstance(analyzer, Completeness):
+def _asserted_per_value(
+    values: np.ndarray, valid: np.ndarray, assertion
+) -> Optional[np.ndarray]:
+    """assertion(value) per row, evaluated once per UNIQUE value and
+    gathered back (the assertion is a Python scalar callable; a direct
+    per-row loop would crawl on wide data). Invalid (null) rows pass —
+    NullBehavior.Ignore, the reference's default — and their
+    zero-fill placeholders NEVER reach the assertion (a partial
+    assertion like ``1/v > 0`` must not see values outside the
+    non-null domain). An assertion that still raises degrades to "no
+    row-level column" (None) rather than aborting the whole export —
+    the aggregate path already reported the same exception as a
+    FAILURE ConstraintResult."""
+    out = np.ones(len(values), dtype=bool)
+    real = values[valid]
+    uniques, inverse = np.unique(real, return_inverse=True)
+    try:
+        lut = np.fromiter(
+            (bool(assertion(v)) for v in uniques.tolist()),
+            dtype=bool,
+            count=len(uniques),
+        )
+    except Exception:  # noqa: BLE001 — degrade, mirroring the
+        return None  # aggregate constraint's FAILURE result
+    out[valid] = lut[inverse]
+    return out
+
+
+def _outcome_for(
+    analyzer: Analyzer, data: Dataset, assertion=None
+) -> Optional[np.ndarray]:
+    if isinstance(analyzer, (MinLength, MaxLength)):
+        if assertion is None:
+            return None
+        lengths = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, "lengths"))
+        )
+        valid = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, "mask")),
+            dtype=bool,
+        )
+        out = _asserted_per_value(lengths, valid, assertion)
+    elif isinstance(analyzer, (Minimum, Maximum)):
+        if assertion is None:
+            return None
+        values = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, "values"))
+        )
+        valid = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, "mask")),
+            dtype=bool,
+        )
+        out = _asserted_per_value(values, valid, assertion)
+    elif isinstance(analyzer, Completeness):
         mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
         out = np.asarray(mask, dtype=bool).copy()
     elif isinstance(analyzer, Compliance):
@@ -108,16 +183,26 @@ def _outcome_for(analyzer: Analyzer, data: Dataset) -> Optional[np.ndarray]:
         out = counts[inverse] == 1
     else:
         return None
-
-    excluded = _where_pass(getattr(analyzer, "where", None), data)
-    if excluded is not None:
-        out = out | excluded
     return out
 
 
-def row_level_results(check_results, data: Dataset) -> Dataset:
+def row_level_results(
+    check_results,
+    data: Dataset,
+    filtered_row_outcome: str = "true",
+) -> Dataset:
     """One boolean column per row-level-capable constraint, named by the
-    constraint, over ``data`` (the dataset the suite ran on)."""
+    constraint, over ``data`` (the dataset the suite ran on).
+
+    ``filtered_row_outcome`` — what a row EXCLUDED by the constraint's
+    ``where`` filter reports (reference: AnalyzerOptions.filteredRow):
+    ``"true"`` (default) marks it passing; ``"null"`` yields SQL NULL
+    in a nullable boolean column."""
+    if filtered_row_outcome not in ("true", "null"):
+        raise ValueError(
+            "filtered_row_outcome must be 'true' or 'null', got "
+            f"{filtered_row_outcome!r}"
+        )
     columns: Dict[str, pa.Array] = {}
     for check, result in check_results.items():
         for cr in result.constraint_results:
@@ -128,10 +213,22 @@ def row_level_results(check_results, data: Dataset) -> Dataset:
                 inner = constraint
             if not isinstance(inner, AnalysisBasedConstraint):
                 continue
-            outcome = _outcome_for(inner.analyzer, data)
+            outcome = _outcome_for(
+                inner.analyzer, data, assertion=inner.assertion
+            )
             if outcome is None:
                 continue
-            columns[str(constraint)] = pa.array(outcome)
+            excluded = _where_pass(
+                getattr(inner.analyzer, "where", None), data
+            )
+            if excluded is None:
+                columns[str(constraint)] = pa.array(outcome)
+            elif filtered_row_outcome == "true":
+                columns[str(constraint)] = pa.array(outcome | excluded)
+            else:  # "null": excluded rows are SQL NULL
+                columns[str(constraint)] = pa.array(
+                    outcome, mask=excluded
+                )
     if not columns:
         return Dataset(pa.table({"__no_row_level_constraints__": pa.array([], pa.bool_())}))
     return Dataset(pa.table(columns))

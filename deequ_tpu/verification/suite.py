@@ -45,11 +45,16 @@ class VerificationResult:
         self.run_metadata = None  # per-pass timings (set by the suite)
 
     def row_level_results_as_dataset(
-        self, data: Optional[Dataset] = None
+        self,
+        data: Optional[Dataset] = None,
+        filtered_row_outcome: str = "true",
     ) -> Dataset:
         """Per-row pass/fail per row-level-capable constraint (reference:
         rowLevelResultsAsDataFrame — SURVEY.md §2.2). Pass ``data``
-        explicitly for runs evaluated from aggregated states."""
+        explicitly for runs evaluated from aggregated states.
+        ``filtered_row_outcome``: "true" (where-excluded rows pass,
+        default) or "null" (SQL NULL in a nullable boolean column) —
+        the reference's AnalyzerOptions.filteredRow semantics."""
         from deequ_tpu.verification.rowlevel import row_level_results
 
         target = data if data is not None else self._data
@@ -58,7 +63,10 @@ class VerificationResult:
                 "row-level results need the dataset; this result was "
                 "computed without one (state-only run) — pass data="
             )
-        return row_level_results(self.check_results, target)
+        return row_level_results(
+            self.check_results, target,
+            filtered_row_outcome=filtered_row_outcome,
+        )
 
     # -- exporters (reference: VerificationResult companion object) -----
 

@@ -180,3 +180,24 @@ class TestHighCardinalityPaths:
         assert merged.num_groups == k + k // 2
         assert merged.counts.sum() == 2 * k
         assert merged.num_rows == 2 * k
+
+
+def test_nan_payloads_group_together():
+    """Different NaN BIT PATTERNS are one group on every path (Spark
+    NaN==NaN; Arrow's group_by would otherwise split them — verified
+    empirically in r4 review), and -0.0 groups with 0.0."""
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu import CountDistinct, Dataset
+    from deequ_tpu.analyzers import AnalysisRunner
+
+    bits = np.array(
+        [0x7FF8000000000000, 0xFFF8000000000000, 0x7FF8000000000001],
+        dtype=np.uint64,
+    ).view(np.float64)
+    values = np.concatenate([bits, np.array([-0.0, 0.0, 2.5])])
+    ds = Dataset.from_arrow(pa.table({"x": pa.array(values)}))
+    ctx = AnalysisRunner.do_analysis_run(ds, [CountDistinct(["x"])])
+    # {NaN, 0.0, 2.5} = 3 groups
+    assert ctx.metric(CountDistinct(["x"])).value.get() == 3.0
