@@ -162,7 +162,12 @@ def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
             )
         filled = col
         if kind == Kind.TIMESTAMP:
-            filled = pc.cast(col, pa.int64())
+            if pa.types.is_date32(col.type):
+                # Arrow has no chunked date32->int64 kernel; hop
+                # through int32 (days since epoch, exact)
+                filled = pc.cast(pc.cast(col, pa.int32()), pa.int64())
+            else:
+                filled = pc.cast(col, pa.int64())
             if col.null_count:
                 filled = pc.fill_null(filled, pa.scalar(0, pa.int64()))
         elif col.null_count:

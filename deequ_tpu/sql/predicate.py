@@ -16,11 +16,38 @@ compiles the expression to pure JAX ops at plan time:
 Three-valued logic follows SQL: comparisons involving NULL are NULL; a
 row "complies" iff the predicate is TRUE (not NULL, not FALSE).
 
-Supported grammar: OR / AND / NOT, comparisons (= == != <> < <= > >=),
-arithmetic (+ - * / %), IS [NOT] NULL, [NOT] IN (...), BETWEEN x AND y,
-[NOT] LIKE 'pat%' (SQL wildcards), RLIKE 'regex', unary minus, literals
-(numbers, 'strings', TRUE/FALSE/NULL), parentheses, and a few functions
-(ABS, LENGTH).
+Supported grammar (r4 extends toward the reference's Spark SQL surface;
+SURVEY.md §2.2 Compliance = "arbitrary SQL predicate"):
+
+| form | notes |
+|---|---|
+| OR / AND / NOT | SQL three-valued logic |
+| = == != <> < <= > >= | string orderings via shared lexicographic ranks |
+| + - * / % , unary - | / and % by zero -> NULL |
+| IS [NOT] NULL | |
+| [NOT] IN (...) | string or numeric item lists |
+| BETWEEN x AND y | |
+| [NOT] LIKE 'pat%' / RLIKE 're' | host regex over the dictionary |
+| CASE WHEN c THEN v ... [ELSE v] END | numeric/bool branch values |
+| COALESCE(a, b, ...) | numeric/bool arguments |
+| ABS(x) | |
+| LENGTH(s) | also over TRIM/UPPER/... results |
+| TRIM/LTRIM/RTRIM(s) | host transform over the dictionary |
+| UPPER(s) / LOWER(s) | compose freely, e.g. UPPER(TRIM(s)) |
+| SUBSTR/SUBSTRING(s, pos[, len]) | Spark 1-based semantics |
+| ts_col <op> 'YYYY-MM-DD[ HH:MM:SS]' | date literal in the column's unit |
+| literals | numbers, 'strings', TRUE/FALSE/NULL |
+
+String functions never reach the device: they evaluate host-side over
+the (small) column dictionary, composing into per-code lookup tables;
+the device work stays a gather over codes (SURVEY.md §7 hard part #3).
+Unsupported syntax fails at PLANNING time (PredicateParseError), which
+the runner degrades to that analyzer's failure metric — never a crash
+mid-scan.
+
+Known not-yet-implemented vs full Spark SQL (documented, degrade
+cleanly): string-valued CASE/COALESCE results, CONCAT, date arithmetic
+(date_add/datediff), casts.
 """
 
 from __future__ import annotations
@@ -52,7 +79,7 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "AND", "OR", "NOT", "IS", "NULL", "IN", "BETWEEN", "LIKE", "RLIKE",
-    "TRUE", "FALSE",
+    "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
 }
 
 
@@ -163,6 +190,14 @@ class Like(Node):
     pattern: str
     regex: bool
     negate: bool
+
+
+@dataclass(frozen=True)
+class CaseWhen(Node):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2 ...] [ELSE v] END."""
+
+    whens: Tuple[Tuple[Node, Node], ...]
+    else_: Optional[Node]
 
 
 @dataclass(frozen=True)
@@ -312,6 +347,19 @@ class _Parser:
 
     def primary(self) -> Node:
         tok = self.next()
+        if tok.kind == "kw" and tok.text == "CASE":
+            whens: List[Tuple[Node, Node]] = []
+            while self.accept("kw", "WHEN"):
+                cond = self.or_expr()
+                self.expect("kw", "THEN")
+                whens.append((cond, self.or_expr()))
+            if not whens:
+                raise PredicateParseError(
+                    "CASE requires at least one WHEN ... THEN branch"
+                )
+            else_ = self.or_expr() if self.accept("kw", "ELSE") else None
+            self.expect("kw", "END")
+            return CaseWhen(tuple(whens), else_)
         if tok.kind == "number":
             return NumberLit(float(tok.text))
         if tok.kind == "string":
@@ -378,6 +426,16 @@ class _Val:
     valid: jnp.ndarray
     is_bool: bool = False
     codes_of: Optional[str] = None  # column name whose dictionary applies
+    # host-side string transform composed over the dictionary (TRIM/
+    # UPPER/LOWER/SUBSTR chains): consumers build per-code LUTs from
+    # transform(dict[i]) instead of dict[i]; None = raw values
+    transform: Optional[Callable[[str], str]] = None
+    # set for TIMESTAMP/date columns: comparisons against 'YYYY-MM-DD'
+    # string literals convert the literal into this column's epoch unit
+    ts_col: Optional[str] = None
+
+    def view(self, value: str) -> str:
+        return self.transform(value) if self.transform else value
 
 
 class _PredicateData:
@@ -400,6 +458,16 @@ class _PredicateData:
                 "the data"
             )
         return dataset.dictionary(column)
+
+    def arrow_type(self, column: str):
+        """Storage type (timestamp predicates need the epoch unit)."""
+        dataset = self._ref()
+        if dataset is None:  # pragma: no cover — contract violation
+            raise RuntimeError(
+                "timestamp predicate outlived its dataset; it is only "
+                "traced while the owning run holds the data"
+            )
+        return dataset._column_arrow_type(column)
 
 
 class CompiledPredicate:
@@ -430,12 +498,13 @@ class CompiledPredicate:
         self._schema = dataset.schema
         self.columns_used = tuple(columns_used)
         self.requests = tuple(requests)
-        # a predicate touching NO string column evaluates identically on
-        # any dataset with the same schema kinds (no dictionary-derived
-        # constants get baked into its closure) — the engine's plan
-        # cache may reuse compiled scans across datasets only then
+        # a predicate touching NO string and NO timestamp column
+        # evaluates identically on any dataset with the same schema
+        # kinds (no dictionary-derived constants and no unit-dependent
+        # epoch literals get baked into its closure) — the engine's
+        # plan cache may reuse compiled scans across datasets only then
         self.dataset_independent = all(
-            dataset.schema.kind_of(c) != Kind.STRING
+            dataset.schema.kind_of(c) not in (Kind.STRING, Kind.TIMESTAMP)
             for c in self.columns_used
         )
 
@@ -498,9 +567,12 @@ def _check_types(node: Node, schema) -> str:
 
     def kind_of(n: Node) -> str:
         if isinstance(n, ColumnRef):
-            return (
-                "string" if schema.kind_of(n.name) == Kind.STRING else "value"
-            )
+            k = schema.kind_of(n.name)
+            if k == Kind.STRING:
+                return "string"
+            if k == Kind.TIMESTAMP:
+                return "timestamp"
+            return "value"
         if isinstance(n, StringLit):
             return "stringlit"
         if isinstance(n, NullLit):
@@ -521,6 +593,24 @@ def _check_types(node: Node, schema) -> str:
         if isinstance(n, Between):
             check_cmp(n.operand, n.low)
             check_cmp(n.operand, n.high)
+            return "value"
+        if isinstance(n, CaseWhen):
+            for cond, result in n.whens:
+                if kind_of(cond) in ("string", "stringlit"):
+                    raise PredicateParseError(
+                        "a CASE condition must be boolean, not a bare "
+                        "string operand"
+                    )
+                if kind_of(result) in ("string", "stringlit"):
+                    raise PredicateParseError(
+                        "string-valued CASE results are not supported"
+                    )
+            if n.else_ is not None and kind_of(n.else_) in (
+                "string", "stringlit",
+            ):
+                raise PredicateParseError(
+                    "string-valued CASE results are not supported"
+                )
             return "value"
         if isinstance(n, InList):
             base = kind_of(n.operand)
@@ -546,7 +636,7 @@ def _check_types(node: Node, schema) -> str:
             # aggregates (SUM/COUNT/...) belong to CustomSql expressions
             # and must fail HERE (planning time), not mid-trace where
             # they would poison every co-scheduled analyzer
-            if n.name not in ("ABS", "LENGTH"):
+            if n.name not in ("ABS", "LENGTH", "COALESCE") + _STRING_FNS:
                 raise PredicateParseError(
                     f"unsupported function {n.name} in a predicate"
                 )
@@ -555,6 +645,40 @@ def _check_types(node: Node, schema) -> str:
                     raise PredicateParseError(
                         f"* is not a valid argument to {n.name}"
                     )
+            if n.name in _STRING_FNS:
+                # FULL static validation here: a raise later, inside
+                # the shared fused-scan trace, would poison every
+                # co-scheduled analyzer (this module's core invariant)
+                if n.name in ("SUBSTR", "SUBSTRING"):
+                    if len(n.args) not in (2, 3):
+                        raise PredicateParseError(
+                            f"{n.name} takes (string, pos[, length])"
+                        )
+                    _static_int(n.args[1], f"{n.name} position")
+                    if len(n.args) == 3:
+                        _static_int(n.args[2], f"{n.name} length")
+                elif len(n.args) != 1:
+                    raise PredicateParseError(
+                        f"{n.name} takes exactly one argument"
+                    )
+                if kind_of(n.args[0]) != "string":
+                    raise PredicateParseError(
+                        f"{n.name} requires a string column operand"
+                    )
+                return "string"
+            if n.name == "COALESCE":
+                for a in n.args:
+                    if kind_of(a) in ("string", "stringlit"):
+                        raise PredicateParseError(
+                            "COALESCE over string columns is not "
+                            "supported (numeric/boolean arguments only)"
+                        )
+                return "value"
+            if n.name == "LENGTH":
+                for a in n.args:
+                    kind_of(a)
+                return "value"
+            for a in n.args:
                 kind_of(a)
             return "value"
         if isinstance(n, BinOp):
@@ -569,6 +693,7 @@ def _check_types(node: Node, schema) -> str:
             lk, rk = kind_of(n.left), kind_of(n.right)
             if n.op in _CMP:
                 check_kinds(lk, rk, n.op)
+                check_ts_literal(n.left, lk, n.right, rk)
                 return "value"
             # arithmetic
             for k in (lk, rk):
@@ -584,6 +709,13 @@ def _check_types(node: Node, schema) -> str:
         stringish = ("string", "stringlit")
         if "null" in (lk, rk):
             return
+        # timestamp vs string literal: the literal is a date — valid
+        if {"timestamp", "stringlit"} == {lk, rk}:
+            return
+        if lk == "timestamp":
+            lk = "value"
+        if rk == "timestamp":
+            rk = "value"
         if (lk in stringish) != (rk in stringish):
             raise PredicateParseError(
                 "cannot compare a string operand with a non-string "
@@ -594,10 +726,42 @@ def _check_types(node: Node, schema) -> str:
                 f"comparison {op!r} of two string literals is constant"
             )
 
+    def check_ts_literal(a: Node, ak: str, b: Node, bk: str) -> None:
+        """A timestamp-vs-string-literal compare carries a STATIC date
+        literal — validate it NOW (plan time), not mid-trace."""
+        import datetime as _dt
+
+        for node_, kind_, other in ((a, ak, bk), (b, bk, ak)):
+            if kind_ == "stringlit" and other == "timestamp":
+                assert isinstance(node_, StringLit)
+                try:
+                    _dt.datetime.fromisoformat(node_.value)
+                except ValueError as exc:
+                    raise PredicateParseError(
+                        f"{node_.value!r} is not a date/timestamp "
+                        "literal (YYYY-MM-DD[ HH:MM:SS])"
+                    ) from exc
+
     def check_cmp(a: Node, b: Node) -> None:
         check_kinds(kind_of(a), kind_of(b), "BETWEEN")
+        check_ts_literal(a, kind_of(a), b, kind_of(b))
 
     return kind_of(node)
+
+
+def _children_of(node: Node):
+    """Every child Node, uniformly across node shapes (incl. CASE)."""
+    for attr in ("operand", "left", "right", "low", "high", "else_"):
+        child = getattr(node, attr, None)
+        if isinstance(child, Node):
+            yield child
+    for attr in ("items", "args"):
+        for child in getattr(node, attr, ()):
+            if isinstance(child, Node):
+                yield child
+    for pair in getattr(node, "whens", ()):
+        yield pair[0]
+        yield pair[1]
 
 
 def _length_columns_of(node: Node) -> set:
@@ -607,13 +771,8 @@ def _length_columns_of(node: Node) -> set:
         for arg in node.args:
             if isinstance(arg, ColumnRef):
                 out.add(arg.name)
-    for attr in ("operand", "left", "right", "low", "high"):
-        child = getattr(node, attr, None)
-        if isinstance(child, Node):
-            out |= _length_columns_of(child)
-    for attr in ("items", "args"):
-        for child in getattr(node, attr, ()):
-            out |= _length_columns_of(child)
+    for child in _children_of(node):
+        out |= _length_columns_of(child)
     return out
 
 
@@ -621,14 +780,8 @@ def _columns_of(node: Node) -> set:
     if isinstance(node, ColumnRef):
         return {node.name}
     out: set = set()
-    for attr in ("operand", "left", "right", "low", "high"):
-        child = getattr(node, attr, None)
-        if isinstance(child, Node):
-            out |= _columns_of(child)
-    for attr in ("items", "args"):
-        children = getattr(node, attr, ())
-        for child in children:
-            out |= _columns_of(child)
+    for child in _children_of(node):
+        out |= _columns_of(child)
     return out
 
 
@@ -655,25 +808,50 @@ def _dict_lookup(dataset: Dataset, column: str, value: str) -> int:
     return int(matches[0]) if len(matches) else -2  # -2: matches nothing
 
 
+def _string_eq_lut(ds: Dataset, base: "_Val", literal: str) -> jnp.ndarray:
+    """Per-code bool LUT for ``view(dict[i]) == literal`` — required
+    when a transform applies (several raw entries may map to the same
+    transformed value, so a single-code lookup can't represent it)."""
+    dictionary = ds.dictionary(base.codes_of)
+    table = np.zeros(len(dictionary) + 1, dtype=bool)
+    for i, s in enumerate(dictionary):
+        if s is not None and base.view(str(s)) == literal:
+            table[i] = True
+    lut = jnp.asarray(table)
+    idx = jnp.where(base.values < 0, len(dictionary), base.values)
+    return lut[jnp.clip(idx, 0, len(dictionary))]
+
+
 def _rank_table(
-    dictionaries: "list[np.ndarray]", extra: "list[str]"
+    views: "list[list[str]]", extra: "list[str]"
 ) -> "dict[str, int]":
     """Lexicographic rank of every distinct string across the given
-    dictionaries (+ literals): the shared value domain that makes codes
-    from unrelated dictionaries comparable."""
+    (already-transformed) dictionary views (+ literals): the shared
+    value domain that makes codes from unrelated dictionaries — or
+    transformed views of them — comparable."""
     values = set(extra)
-    for d in dictionaries:
-        values.update(str(v) for v in d if v is not None)
+    for view in views:
+        values.update(v for v in view if v is not None)
     return {v: i for i, v in enumerate(sorted(values))}
 
 
-def _ranks_for(dictionary: np.ndarray, rank: "dict[str, int]") -> np.ndarray:
+def _dict_view(ds: Dataset, val: "_Val") -> "list[Optional[str]]":
+    """The dictionary as the expression sees it: transform applied."""
+    return [
+        None if v is None else val.view(str(v))
+        for v in ds.dictionary(val.codes_of)
+    ]
+
+
+def _ranks_for(
+    view: "list[Optional[str]]", rank: "dict[str, int]"
+) -> np.ndarray:
     """int32 LUT code -> shared rank; one trailing slot (-1) for null
     codes so a single clipped gather covers every code."""
-    out = np.full(len(dictionary) + 1, -1, dtype=np.int32)
-    for i, v in enumerate(dictionary):
+    out = np.full(len(view) + 1, -1, dtype=np.int32)
+    for i, v in enumerate(view):
         if v is not None:
-            out[i] = rank[str(v)]
+            out[i] = rank[v]
     return out
 
 
@@ -683,16 +861,127 @@ def _gather_ranks(lut: np.ndarray, codes: jnp.ndarray) -> jnp.ndarray:
     return table[jnp.clip(idx, 0, table.shape[0] - 1)]
 
 
-def _shared_rank_luts(dataset: Dataset, col_a: str, col_b: str):
-    da, db = dataset.dictionary(col_a), dataset.dictionary(col_b)
-    rank = _rank_table([da, db], [])
-    return _ranks_for(da, rank), _ranks_for(db, rank)
+def _shared_rank_luts(dataset: Dataset, a: "_Val", b: "_Val"):
+    va, vb = _dict_view(dataset, a), _dict_view(dataset, b)
+    rank = _rank_table(
+        [[x for x in va if x is not None], [x for x in vb if x is not None]],
+        [],
+    )
+    return _ranks_for(va, rank), _ranks_for(vb, rank)
 
 
-def _rank_lut_with_literal(dataset: Dataset, column: str, literal: str):
-    d = dataset.dictionary(column)
-    rank = _rank_table([d], [literal])
-    return _ranks_for(d, rank), rank[literal]
+def _rank_lut_with_literal(dataset: Dataset, base: "_Val", literal: str):
+    view = _dict_view(dataset, base)
+    rank = _rank_table([[x for x in view if x is not None]], [literal])
+    return _ranks_for(view, rank), rank[literal]
+
+
+_STRING_FNS = ("TRIM", "LTRIM", "RTRIM", "UPPER", "LOWER", "SUBSTR",
+               "SUBSTRING")
+
+
+def _static_int(node: Node, what: str) -> int:
+    """A SUBSTR position/length argument must be a static integer."""
+    if isinstance(node, UnaryOp) and node.op == "NEG":
+        return -_static_int(node.operand, what)
+    if isinstance(node, NumberLit) and float(node.value).is_integer():
+        return int(node.value)
+    raise PredicateParseError(f"{what} must be an integer literal")
+
+
+def _substr(s: str, pos: int, length: Optional[int]) -> str:
+    """Spark substring semantics: 1-based; pos 0 behaves like 1;
+    negative pos counts from the end; negative length -> empty."""
+    if pos > 0:
+        start = pos - 1
+    elif pos < 0:
+        start = max(len(s) + pos, 0)
+    else:
+        start = 0
+    if length is None:
+        return s[start:]
+    if length <= 0:
+        return ""
+    return s[start:start + length]
+
+
+def _eval_string_fn(
+    node: "FuncCall", batch: Dict[str, jnp.ndarray], ds: Dataset
+) -> "_Val":
+    """TRIM/LTRIM/RTRIM/UPPER/LOWER/SUBSTR compose a host-side
+    transform over the operand's dictionary view; codes/validity pass
+    through untouched (the device never sees strings)."""
+    if node.name in ("SUBSTR", "SUBSTRING"):
+        if len(node.args) not in (2, 3):
+            raise PredicateParseError(
+                f"{node.name} takes (string, pos[, length])"
+            )
+        base = _eval(node.args[0], batch, ds)
+        pos = _static_int(node.args[1], f"{node.name} position")
+        length = (
+            _static_int(node.args[2], f"{node.name} length")
+            if len(node.args) == 3
+            else None
+        )
+        inner = base.view
+
+        def transform(s: str, _pos=pos, _len=length, _inner=inner):
+            return _substr(_inner(s), _pos, _len)
+
+    else:
+        if len(node.args) != 1:
+            raise PredicateParseError(
+                f"{node.name} takes exactly one argument"
+            )
+        base = _eval(node.args[0], batch, ds)
+        inner = base.view
+        fn = {
+            "TRIM": str.strip,
+            "LTRIM": str.lstrip,
+            "RTRIM": str.rstrip,
+            "UPPER": str.upper,
+            "LOWER": str.lower,
+        }[node.name]
+
+        def transform(s: str, _fn=fn, _inner=inner):
+            return _fn(_inner(s))
+
+    if base.codes_of is None:
+        raise PredicateParseError(
+            f"{node.name} requires a string column operand"
+        )
+    return _Val(
+        base.values, base.valid, codes_of=base.codes_of,
+        transform=transform,
+    )
+
+
+def _date_literal_epoch(ds, column: str, literal: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> the column's int64 epoch
+    value (same cast the values repr uses: pc.cast(col, int64) keeps
+    the storage unit, so converting the LITERAL through the same arrow
+    type makes the numeric compare exact)."""
+    import datetime as _dt
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    try:
+        dt = _dt.datetime.fromisoformat(literal)
+    except ValueError as exc:
+        raise PredicateParseError(
+            f"{literal!r} is not a date/timestamp literal "
+            "(YYYY-MM-DD[ HH:MM:SS])"
+        ) from exc
+    arrow_type = ds.arrow_type(column)
+    value = dt.date() if pa.types.is_date(arrow_type) else dt
+    arr = pa.array([value], type=arrow_type)
+    if pa.types.is_date32(arrow_type):
+        # Arrow has no date32->int64 kernel; hop through int32 — the
+        # SAME two-step the values repr uses (convert_basic_repr), so
+        # literal and column land in identical units (days)
+        arr = pc.cast(arr, pa.int32())
+    return int(pc.cast(arr, pa.int64())[0].as_py())
 
 
 def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
@@ -702,7 +991,12 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         if kind == Kind.STRING:
             return _Val(batch[f"{node.name}::codes"], mask, codes_of=node.name)
         vals = batch[f"{node.name}::values"]
-        return _Val(vals, mask, is_bool=kind == Kind.BOOLEAN)
+        return _Val(
+            vals,
+            mask,
+            is_bool=kind == Kind.BOOLEAN,
+            ts_col=node.name if kind == Kind.TIMESTAMP else None,
+        )
     if isinstance(node, NumberLit):
         return _Val(jnp.asarray(node.value), jnp.asarray(True))
     if isinstance(node, BoolLit):
@@ -735,6 +1029,35 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             batch,
             ds,
         )
+    if isinstance(node, CaseWhen):
+        # SQL: first branch whose condition is TRUE wins (NULL
+        # conditions skip); no match and no ELSE -> NULL. Folded in
+        # reverse so earlier branches override later ones.
+        if node.else_ is not None:
+            acc = _eval(node.else_, batch, ds)
+        else:
+            acc = _Val(jnp.asarray(0.0), jnp.asarray(False))
+        if acc.codes_of is not None:
+            raise PredicateParseError(
+                "string-valued CASE results are not supported"
+            )
+        # branch values coerce to f64 (SQL promotes mixed numeric/bool
+        # CASE branches); truth of the result is still `!= 0`
+        vals = jnp.asarray(acc.values, dtype=jnp.float64)
+        valid = acc.valid
+        for cond, result in reversed(node.whens):
+            ct, cv = _as_bool(_eval(cond, batch, ds))
+            hit = ct & cv
+            r = _eval(result, batch, ds)
+            if r.codes_of is not None:
+                raise PredicateParseError(
+                    "string-valued CASE results are not supported"
+                )
+            vals = jnp.where(
+                hit, jnp.asarray(r.values, dtype=jnp.float64), vals
+            )
+            valid = jnp.where(hit, r.valid, valid)
+        return _Val(vals, valid)
     if isinstance(node, InList):
         base = _eval(node.operand, batch, ds)
         truth = jnp.zeros_like(base.values, dtype=bool)
@@ -748,8 +1071,11 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                     raise PredicateParseError(
                         "IN with string literals requires a string column"
                     )
-                code = _dict_lookup(ds, base.codes_of, item.value)
-                truth = truth | (base.values == code)
+                if base.transform is not None:
+                    truth = truth | _string_eq_lut(ds, base, item.value)
+                else:
+                    code = _dict_lookup(ds, base.codes_of, item.value)
+                    truth = truth | (base.values == code)
             else:
                 rhs = _eval(item, batch, ds)
                 truth = truth | ((base.values == rhs.values) & rhs.valid)
@@ -770,7 +1096,7 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         prog = re.compile(pattern)
         table = np.zeros(len(dictionary) + 1, dtype=bool)
         for i, s in enumerate(dictionary):
-            if s is not None and prog.search(str(s)):
+            if s is not None and prog.search(base.view(str(s))):
                 table[i] = True
         lut = jnp.asarray(table)
         truth = lut[jnp.clip(base.values, -1, len(dictionary) - 1)]
@@ -782,12 +1108,47 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         if node.name == "ABS" and len(node.args) == 1:
             v = _eval(node.args[0], batch, ds)
             return _Val(jnp.abs(v.values), v.valid)
+        if node.name == "COALESCE":
+            if not node.args:
+                raise PredicateParseError("COALESCE needs arguments")
+            parts = [_eval(a, batch, ds) for a in node.args]
+            if any(p.codes_of is not None for p in parts):
+                raise PredicateParseError(
+                    "COALESCE over string columns is not supported "
+                    "(numeric/boolean arguments only)"
+                )
+            vals = parts[0].values
+            valid = parts[0].valid
+            for p in parts[1:]:
+                vals = jnp.where(valid, vals, p.values)
+                valid = valid | p.valid
+            return _Val(
+                vals, valid, is_bool=all(p.is_bool for p in parts)
+            )
         if node.name == "LENGTH" and len(node.args) == 1:
             arg = node.args[0]
             if isinstance(arg, ColumnRef):
                 mask = batch[f"{arg.name}::mask"]
                 return _Val(batch[f"{arg.name}::lengths"], mask)
-            raise PredicateParseError("LENGTH expects a column")
+            # LENGTH over a transformed string expression: per-code
+            # i32 LUT of len(view(dict[i])), gathered by code
+            v = _eval(arg, batch, ds)
+            if v.codes_of is None:
+                raise PredicateParseError(
+                    "LENGTH expects a string column or string function"
+                )
+            dictionary = ds.dictionary(v.codes_of)
+            table = np.zeros(len(dictionary) + 1, dtype=np.int32)
+            for i, s in enumerate(dictionary):
+                if s is not None:
+                    table[i] = len(v.view(str(s)))
+            lut = jnp.asarray(table)
+            idx = jnp.where(v.values < 0, len(dictionary), v.values)
+            return _Val(
+                lut[jnp.clip(idx, 0, len(dictionary))], v.valid
+            )
+        if node.name in _STRING_FNS:
+            return _eval_string_fn(node, batch, ds)
         raise PredicateParseError(f"unsupported function {node.name}")
     if isinstance(node, BinOp):
         if node.op in ("AND", "OR"):
@@ -815,18 +1176,34 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 else (node.right, node.left)
             )
             base = _eval(col_node, batch, ds)
+            if base.ts_col is not None:
+                # timestamp vs date literal: the literal converts to
+                # the COLUMN's epoch unit at trace time; the device
+                # compare stays numeric
+                epoch = _date_literal_epoch(ds, base.ts_col, lit.value)
+                lv, rv = (
+                    (base.values, epoch)
+                    if lit_on_right
+                    else (epoch, base.values)
+                )
+                return _Val(
+                    _CMP_FNS[node.op](lv, rv), base.valid, is_bool=True
+                )
             if base.codes_of is None:
                 raise PredicateParseError(
                     "string comparison requires a string column"
                 )
             if node.op in ("=", "!="):
-                code = _dict_lookup(ds, base.codes_of, lit.value)
-                truth = base.values == code
+                if base.transform is not None:
+                    truth = _string_eq_lut(ds, base, lit.value)
+                else:
+                    code = _dict_lookup(ds, base.codes_of, lit.value)
+                    truth = base.values == code
                 if node.op == "!=":
                     truth = ~truth
                 return _Val(truth, base.valid, is_bool=True)
             ranks, lit_rank = _rank_lut_with_literal(
-                ds, base.codes_of, lit.value
+                ds, base, lit.value
             )
             col_ranks = _gather_ranks(ranks, base.values)
             lv, rv = (
@@ -844,9 +1221,7 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 # order of appearance, not sorted) — remap both sides to
                 # ranks in a shared sorted value domain so =/!= and
                 # lexicographic ordering are exact
-                lut_l, lut_r = _shared_rank_luts(
-                    ds, lhs.codes_of, rhs.codes_of
-                )
+                lut_l, lut_r = _shared_rank_luts(ds, lhs, rhs)
                 lv = _gather_ranks(lut_l, lv)
                 rv = _gather_ranks(lut_r, rv)
             elif (lhs.codes_of is None) != (rhs.codes_of is None):

@@ -105,30 +105,35 @@ def _asserted_per_value(
 
 
 def _outcome_for(
-    analyzer: Analyzer, data: Dataset, assertion=None
+    analyzer: Analyzer,
+    data: Dataset,
+    assertion=None,
+    excluded: Optional[np.ndarray] = None,
 ) -> Optional[np.ndarray]:
+    def _asserted(repr_name: str) -> Optional[np.ndarray]:
+        values = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, repr_name))
+        )
+        valid = np.asarray(
+            data.materialize(ColumnRequest(analyzer.column, "mask")),
+            dtype=bool,
+        )
+        if excluded is not None:
+            # where-excluded rows are outside the assertion's domain
+            # exactly like nulls: a partial assertion safe on the
+            # FILTERED data must not see their values (the caller
+            # overrides their outcome per filtered_row_outcome)
+            valid = valid & ~excluded
+        return _asserted_per_value(values, valid, assertion)
+
     if isinstance(analyzer, (MinLength, MaxLength)):
         if assertion is None:
             return None
-        lengths = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, "lengths"))
-        )
-        valid = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, "mask")),
-            dtype=bool,
-        )
-        out = _asserted_per_value(lengths, valid, assertion)
+        out = _asserted("lengths")
     elif isinstance(analyzer, (Minimum, Maximum)):
         if assertion is None:
             return None
-        values = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, "values"))
-        )
-        valid = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, "mask")),
-            dtype=bool,
-        )
-        out = _asserted_per_value(values, valid, assertion)
+        out = _asserted("values")
     elif isinstance(analyzer, Completeness):
         mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
         out = np.asarray(mask, dtype=bool).copy()
@@ -213,14 +218,17 @@ def row_level_results(
                 inner = constraint
             if not isinstance(inner, AnalysisBasedConstraint):
                 continue
-            outcome = _outcome_for(
-                inner.analyzer, data, assertion=inner.assertion
-            )
-            if outcome is None:
-                continue
             excluded = _where_pass(
                 getattr(inner.analyzer, "where", None), data
             )
+            outcome = _outcome_for(
+                inner.analyzer,
+                data,
+                assertion=inner.assertion,
+                excluded=excluded,
+            )
+            if outcome is None:
+                continue
             if excluded is None:
                 columns[str(constraint)] = pa.array(outcome)
             elif filtered_row_outcome == "true":
