@@ -344,6 +344,72 @@ def bench_spill_grouping(num_rows: int):
     }
 
 
+def bench_joint_grouping(num_rows: int):
+    """r4 config (VERDICT r3 next #7): MutualInformation + Uniqueness
+    over a PAIR of ~1M-cardinality int columns (joint key space far
+    past the dense budget -> the packed-joint-code device sort), plus
+    an f64 high-cardinality column (host-packed u64 keys on TPU, where
+    the X64 rewriter lacks the f64 bitcast). Host Arrow comparison
+    included."""
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        CountDistinct,
+        MutualInformation,
+        Uniqueness,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        a = rng.integers(0, 1 << 20, num_rows, dtype=np.int64)
+        b = np.where(
+            rng.random(num_rows) < 0.5,
+            a,
+            rng.integers(0, 1 << 20, num_rows),
+        )
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "a": pa.array(a),
+                    "b": pa.array(b),
+                    "f": pa.array(rng.normal(0, 1, num_rows)),
+                }
+            )
+        )
+
+    analyzers = [
+        MutualInformation(["a", "b"]),
+        Uniqueness(["a", "b"]),
+        CountDistinct("f"),
+    ]
+    AnalysisRunner.do_analysis_run(make(21), analyzers)  # warm compile
+    fresh = make(22)
+    wall, shipped, mbps, ctx = _timed(
+        lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+    )
+    with config.configure(device_spill_grouping=False):
+        arrow_wall, _, _, _ = _timed(
+            lambda: AnalysisRunner.do_analysis_run(make(22), analyzers)
+        )
+    events = [
+        e
+        for e in (ctx.run_metadata.events if ctx.run_metadata else [])
+        if e.get("event") == "grouping_spill"
+    ]
+    return {
+        "wall_s": wall,
+        "rows_per_sec": num_rows / wall,
+        "bytes_shipped": shipped,
+        "link_mb_per_sec": mbps,
+        "host_arrow_wall_s": arrow_wall,
+        "device_vs_arrow": arrow_wall / wall,
+        "spill_events": events,
+    }
+
+
 def bench_streaming_parquet(num_rows: int, num_cols: int):
     """Streaming ingest config: profile a multi-file parquet table with
     the device cache disabled — memory stays O(batch), every byte
@@ -501,6 +567,9 @@ def main():
         detail["profiler_50col"] = bench_profiler_wide(1_000_000, 50)
         detail["spill_grouping_12M_distinct"] = bench_spill_grouping(
             12_000_000
+        )
+        detail["joint_grouping_mi_1Mcard_pair"] = bench_joint_grouping(
+            4_000_000
         )
         detail["streaming_parquet"] = bench_streaming_parquet(
             4_000_000, 10

@@ -26,10 +26,18 @@ carrying drop/null flags as extra sort keys:
   bitcast width TPUs lower) widened to u64 — bit-grouping matches
   Arrow's dictionary semantics exactly (-0.0 != +0.0; NaN payloads
   canonicalized so NaN == NaN) and can never reach the sentinel;
-- float64 keys bitcast to u64 directly — only on backends whose X64
-  rewriter lowers 64-bit bitcasts (CPU); on TPU, f64 grouping columns
-  keep the host Arrow fallback (TPU demotes f64 anyway, so a device
-  path could not be bit-exact there);
+- float64 keys bitcast to u64 directly on backends whose X64 rewriter
+  lowers 64-bit bitcasts (CPU); on TPU the rewriter refuses the
+  bitcast (verified r4: "X64 element types ... rewriting is not
+  implemented: bitcast-convert u64"), so f64 keys are packed into u64
+  ON THE HOST (numpy bit view + the same NaN/-0.0 canonicalization)
+  and the u64 keys ship instead of the values — one numpy pass,
+  identical wire bytes, bit-identical groups to the CPU device path;
+- joint key spaces past one u64 lane (> 2^62) sort on TWO u64 lanes
+  via ``lax.sort(num_keys=2)`` — measured on v5e: ~32s one-time
+  compile (vs ~15s single-lane, persistent-cached), warm cost within
+  2x of single-lane at 4M rows; the mixed-radix digits split across
+  the lanes, covering joints to 2^124;
 - the null group (Histogram's ``include_nulls``) is a separate scalar
   count, re-inserted host-side — it never needs a key lane at all.
 
@@ -139,37 +147,116 @@ def _chunk_key_fn(key_kind: str, include_nulls: bool):
     return jax.jit(build)
 
 
-def _segment_count(keys, correction):
-    """Traced: sort flat u64 keys, count segment boundaries, subtract
-    ``correction`` sentinel-valued entries from the trailing segment.
-    This is the ONE copy of the exactness-critical bookkeeping — both
-    the single-device finalize and the per-shard half of the sharded
-    shuffle run it. Output arrays have length N+1 (slot N absorbs
-    non-boundary scatter writes); segments occupy [0, num_segments)
-    and ``gmask`` marks those with a positive corrected count. Counts
-    are i32 (a chip processes < 2^31 rows per state; merges widen)."""
-    n = keys.shape[0]
-    k = jnp.sort(keys)  # ONE sort operand: see module docstring
-    boundary = jnp.concatenate(
-        [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+@functools.lru_cache(maxsize=None)
+def _joint_chunk_key2_fn(n1: int, n2: int):
+    """Two-lane variant of _joint_chunk_key_fn for joint key spaces
+    past one u64 lane: columns [0:n1] pack lane 1, [n1:n1+n2] lane 2
+    (each lane's radix product < 2^62). Sentinel = both lanes max."""
+
+    def build(codes, masks, rows, sizes1, sizes2):
+        any_non_null = jnp.zeros_like(rows)
+        for m in masks:
+            any_non_null = any_non_null | m
+        contributes = rows & any_non_null
+
+        def radix(cs, szs):
+            keys = jnp.zeros(rows.shape, dtype=jnp.uint64)
+            for j in range(len(cs)):
+                shifted = (cs[j].astype(jnp.int64) + 1).astype(jnp.uint64)
+                keys = keys * szs[j].astype(jnp.uint64) + shifted
+            return keys
+
+        k1 = radix(codes[:n1], sizes1)
+        k2 = radix(codes[n1:], sizes2)
+        k1 = jnp.where(contributes, k1, _SENTINEL)
+        k2 = jnp.where(contributes, k2, _SENTINEL)
+        n_sentinel = jnp.sum(~contributes, dtype=jnp.int64)
+        return k1.ravel(), k2.ravel(), n_sentinel
+
+    return jax.jit(build)
+
+
+def host_f64_u64_keys(
+    values: np.ndarray, mask: np.ndarray, rows: np.ndarray,
+    include_nulls: bool,
+):
+    """HOST twin of _chunk_key_fn's f64 branch, for backends whose X64
+    rewriter cannot lower the f64->u64 bitcast (TPU; see module
+    docstring): same canonical-NaN bits, same -0.0 remap, same
+    sentinel bookkeeping — the produced u64 keys are bit-identical to
+    the CPU device path's (pinned by tests), so downstream sort/
+    segment/decode is shared untouched."""
+    bits = (
+        np.ascontiguousarray(values, dtype=np.float64)
+        .view(np.uint64)
+        .copy()
     )
+    x = np.asarray(values, dtype=np.float64)
+    bits[np.isnan(x)] = np.uint64(0x7FF8000000000000)
+    bits[bits == np.uint64(0x8000000000000000)] = np.uint64(0)
+    if include_nulls:
+        null = rows & ~mask
+        contributes = rows & mask
+    else:
+        null = np.zeros_like(rows)
+        contributes = rows & mask
+    keys = np.where(contributes, bits, _SENTINEL)
+    return (
+        keys.ravel(),
+        int(np.sum(~contributes)),
+        int(np.sum(null)),
+    )
+
+
+def _segment_count_lanes(lanes, correction):
+    """Traced: sort flat u64 key LANES lexicographically, count segment
+    boundaries (a boundary wherever ANY lane changes), subtract
+    ``correction`` sentinel-valued entries from the trailing segment.
+    This is the ONE copy of the exactness-critical bookkeeping — the
+    single-device finalize (1 or 2 lanes) and the per-shard half of
+    the sharded shuffle all run it. Output arrays have length N+1
+    (slot N absorbs non-boundary scatter writes); segments occupy
+    [0, num_segments) and ``gmask`` marks those with a positive
+    corrected count. Counts are i32 (a chip processes < 2^31 rows per
+    state; merges widen). The sentinel is max on EVERY lane, so it
+    still sorts last regardless of lane count."""
+    n = lanes[0].shape[0]
+    if len(lanes) == 1:
+        sorted_lanes = (jnp.sort(lanes[0]),)
+    else:
+        sorted_lanes = jax.lax.sort(tuple(lanes), num_keys=len(lanes))
+    changed = jnp.zeros(n - 1, dtype=bool)
+    for k in sorted_lanes:
+        changed = changed | (k[1:] != k[:-1])
+    boundary = jnp.concatenate([jnp.ones(1, dtype=bool), changed])
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     num_segments = seg[-1] + 1
     counts = jnp.zeros(n + 1, dtype=jnp.int32).at[seg].add(1)
     # sentinel-valued entries all sort to the end and share the last
     # segment; the caller knows exactly how many don't belong
-    has_sentinel = k[-1] == _SENTINEL
+    has_sentinel = jnp.ones((), dtype=bool)
+    for k in sorted_lanes:
+        has_sentinel = has_sentinel & (k[-1] == _SENTINEL)
     counts = counts.at[seg[-1]].add(
         -jnp.where(has_sentinel, correction, 0).astype(jnp.int32)
     )
-    group_keys = (
-        jnp.zeros(n + 1, dtype=keys.dtype)
-        .at[jnp.where(boundary, seg, n)]
-        .set(k)
+    scatter_idx = jnp.where(boundary, seg, n)
+    group_lanes = tuple(
+        jnp.zeros(n + 1, dtype=k.dtype).at[scatter_idx].set(k)
+        for k in sorted_lanes
     )
     in_range = jnp.arange(n + 1, dtype=jnp.int32) < num_segments
     gmask = in_range & (counts > 0)
-    return num_segments, counts, group_keys, gmask
+    return num_segments, counts, group_lanes, gmask
+
+
+def _segment_count(keys, correction):
+    """Single-lane wrapper over _segment_count_lanes (the sharded
+    shuffle and the single-column finalize use this shape)."""
+    num_segments, counts, group_lanes, gmask = _segment_count_lanes(
+        (keys,), correction
+    )
+    return num_segments, counts, group_lanes[0], gmask
 
 
 def _entropy_term(counts, gmask, total):
@@ -182,6 +269,17 @@ def _entropy_term(counts, gmask, total):
     return -jnp.sum(jnp.where(c > 0, p * jnp.log(p), 0.0))
 
 
+def _spill_scalars(num_segments, counts, gmask, total):
+    """The on-device scalar summary every finalize shape shares."""
+    return {
+        "num_segments": num_segments.astype(jnp.int64),
+        "num_groups": jnp.sum(gmask, dtype=jnp.int64),
+        "total": total,
+        "unique": jnp.sum((counts == 1) & gmask, dtype=jnp.int64),
+        "entropy": _entropy_term(counts, gmask, total),
+    }
+
+
 @functools.lru_cache(maxsize=None)
 def _finalize_fn():
     """Jitted: flat u64 keys + sentinel count -> per-group arrays and
@@ -192,14 +290,23 @@ def _finalize_fn():
             keys, n_sentinel
         )
         total = (keys.shape[0] - n_sentinel).astype(jnp.int64)
-        scalars = {
-            "num_segments": num_segments.astype(jnp.int64),
-            "num_groups": jnp.sum(gmask, dtype=jnp.int64),
-            "total": total,
-            "unique": jnp.sum((counts == 1) & gmask, dtype=jnp.int64),
-            "entropy": _entropy_term(counts, gmask, total),
-        }
+        scalars = _spill_scalars(num_segments, counts, gmask, total)
         return scalars, group_keys, counts
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _finalize2_fn():
+    """Jitted two-lane finalize (joint keys past one u64 lane)."""
+
+    def run(hi, lo, n_sentinel):
+        num_segments, counts, group_lanes, gmask = _segment_count_lanes(
+            (hi, lo), n_sentinel
+        )
+        total = (hi.shape[0] - n_sentinel).astype(jnp.int64)
+        scalars = _spill_scalars(num_segments, counts, gmask, total)
+        return scalars, group_lanes[0], group_lanes[1], counts
 
     return jax.jit(run)
 
@@ -547,6 +654,117 @@ class DeviceFrequencies(FrequenciesAndNumRows):
         )
 
 
+class TwoLaneDeviceFrequencies(DeviceFrequencies):
+    """DeviceFrequencies for joint keys on TWO u64 lanes (joint space
+    past 2^62): group identity is the (hi, lo) pair; decoding walks
+    each lane's own mixed radix over its own column slice."""
+
+    def __init__(
+        self,
+        columns,
+        scalars,
+        group_hi,
+        group_lo,
+        counts,
+        dictionaries,
+        sizes,
+        split: int,
+    ):
+        super().__init__(
+            columns,
+            np.dtype(np.int64),
+            scalars,
+            (group_hi, group_lo),
+            counts,
+            0,
+            False,
+            joint=(list(dictionaries), list(sizes)),
+        )
+        self._split = split
+        self._keys_host2: Optional[np.ndarray] = None
+
+    def _fetch(self) -> None:
+        if self._counts_host is None:
+            from deequ_tpu.engine.pack import packed_device_get
+
+            # one packed fetch for all three arrays
+            gh, gl, c = packed_device_get(
+                (self._dev[0][0], self._dev[0][1], self._dev[1])
+            )
+            s = self._num_segments
+            raw_hi = np.asarray(gh)[:s]
+            raw_lo = np.asarray(gl)[:s]
+            raw_counts = np.asarray(c)[:s]
+            live = raw_counts > 0
+            self._keys_host = raw_hi[live]
+            self._keys_host2 = raw_lo[live]
+            self._counts_host = raw_counts[live].astype(np.int64)
+
+    @property
+    def keys(self) -> np.ndarray:
+        self._fetch()
+        if self._keys is None:
+            from deequ_tpu.analyzers.grouping import _decode_joint_codes
+
+            dictionaries, sizes = self._joint
+            split = self._split
+            left = _decode_joint_codes(
+                split,
+                self._keys_host.astype(np.int64),
+                dictionaries[:split],
+                sizes[:split],
+            )
+            right = _decode_joint_codes(
+                len(self.columns) - split,
+                self._keys_host2.astype(np.int64),
+                dictionaries[split:],
+                sizes[split:],
+            )
+            self._keys = np.hstack([left, right])
+        return self._keys
+
+    def non_null_group_mask(self) -> np.ndarray:
+        self._fetch()
+        mask = np.ones(len(self._keys_host), dtype=bool)
+        for lane, lane_sizes in (
+            (self._keys_host, self._joint[1][: self._split]),
+            (self._keys_host2, self._joint[1][self._split:]),
+        ):
+            remaining = lane.astype(np.int64).copy()
+            for j in range(len(lane_sizes) - 1, -1, -1):
+                slot = remaining % lane_sizes[j]
+                remaining = remaining // lane_sizes[j]
+                mask &= slot > 0
+        return mask
+
+    # entropy_nats / top_groups: the inherited DeviceFrequencies
+    # methods already take the joint (host-fold) branch for any
+    # instance with _joint set, which this class always has
+
+
+def split_joint_lanes(sizes) -> Optional[int]:
+    """First-fit split index: columns [0:i] on lane 1, [i:] on lane 2,
+    each lane's radix product < 2^62. None when even two lanes cannot
+    hold the joint space (or a single column's radix already overflows
+    a lane — impossible for dictionaries bounded by row count)."""
+    cap = 2**62
+    prod = 1
+    i = 0
+    for s in sizes:
+        if prod * s >= cap:
+            break
+        prod *= s
+        i += 1
+    if i == 0:
+        return None
+    prod2 = 1
+    for s in sizes[i:]:
+        prod2 *= s
+        if prod2 >= cap:
+            return None
+    return i
+
+
 class ShardedDeviceFrequencies(DeviceFrequencies):
     """DeviceFrequencies whose groups live SHARDED across a mesh: each
     device holds the (keys, counts, num_segments) of its disjoint hash
@@ -631,13 +849,20 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
         return False
     if dt.kind == "u" and dt.itemsize == 8:
         return False
+    # f64 keys: CPU-class backends bitcast on device; elsewhere (TPU)
+    # the u64 keys are packed on the HOST (host_f64_u64_keys — the X64
+    # rewriter cannot lower the f64 bitcast, measured r4) and the same
+    # device sort runs. The MESH kernel has no host-packing variant,
+    # so meshed f64 plans must keep the dense/Arrow planning instead
+    # of spilling into a guaranteed run-time fallback
     if dt.kind == "f" and np.dtype(dt).itemsize == 8:
-        # f64 keys need a 64-bit bitcast, which only CPU-class backends
-        # lower (TPU's X64 rewriter has no u64 bitcast and demotes f64
-        # anyway); f64 grouping columns keep the host Arrow path there
         import jax
 
-        if jax.default_backend() != "cpu":
+        if (
+            jax.default_backend() != "cpu"
+            and engine is not None
+            and getattr(engine, "mesh", None) is not None
+        ):
             return False
     # headroom gate: the pass pins values+mask chunks in the cache
     # (~9 B/row) AND allocates sort transients outside cache accounting
@@ -647,12 +872,11 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
     return dataset.num_rows * 64 <= opts.device_cache_bytes
 
 
-def joint_spill_eligible(
-    dataset: Dataset, plan, sizes, engine=None
-) -> bool:
-    """Multi-column variant: dictionaries exist for every column (the
-    dense-path probe already built them) and the joint mixed-radix key
-    space fits u64's value range with headroom below the sentinel."""
+def joint_spill_config_ok(dataset: Dataset, plan, engine=None) -> bool:
+    """The SIZE-INDEPENDENT gates of the joint spill — callers must
+    check these BEFORE probing full per-column cardinalities: the
+    probe can stream a whole distinct set into host memory, which must
+    never happen for a plan the config would reject anyway."""
     from deequ_tpu import config
 
     opts = config.options()
@@ -666,12 +890,19 @@ def joint_spill_eligible(
         return False
     if dataset.num_rows >= 2**31:
         return False
-    joint = 1
-    for s in sizes:
-        joint *= s
-        if joint >= 2**62:
-            return False
     return dataset.num_rows * 64 <= opts.device_cache_bytes
+
+
+def joint_spill_eligible(
+    dataset: Dataset, plan, sizes, engine=None
+) -> bool:
+    """Multi-column variant: config gates pass AND the joint
+    mixed-radix key space fits the sort lanes (one u64 lane below
+    2^62; past that, TWO lanes cover up to ~2^124 provided the digits
+    split across lanes)."""
+    if not joint_spill_config_ok(dataset, plan, engine):
+        return False
+    return split_joint_lanes(tuple(sizes)) is not None
 
 
 def device_spill_joint_frequencies(
@@ -698,10 +929,20 @@ def device_spill_joint_frequencies(
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
     chunk_batches = min(CHUNK_BATCHES, nb)
-    key_fn = _joint_chunk_key_fn(len(columns))
-    sizes_dev = jnp.asarray(np.asarray(sizes, dtype=np.int64))
+    split = split_joint_lanes(tuple(sizes))
+    if split is None:  # planner should have gated; double-check
+        raise SpillOverflow("joint key space exceeds two u64 lanes")
+    two_lane = split < len(columns)
+    if two_lane:
+        key2_fn = _joint_chunk_key2_fn(split, len(columns) - split)
+        sizes1 = jnp.asarray(np.asarray(sizes[:split], dtype=np.int64))
+        sizes2 = jnp.asarray(np.asarray(sizes[split:], dtype=np.int64))
+    else:
+        key_fn = _joint_chunk_key_fn(len(columns))
+        sizes_dev = jnp.asarray(np.asarray(sizes, dtype=np.int64))
 
     keys_parts = []
+    keys2_parts = []
     n_sentinel = jnp.int64(0)
     for chunk in dataset.device_scan_chunks(
         requests,
@@ -713,29 +954,54 @@ def device_spill_joint_frequencies(
         if pred is not None:
             flat = {k: v.reshape(-1) for k, v in chunk.items()}
             rows = rows & pred.complies(flat).reshape(rows.shape)
-        k, ns = key_fn(
-            tuple(chunk[f"{c}::codes"] for c in columns),
-            tuple(chunk[f"{c}::mask"] for c in columns),
-            rows,
-            sizes_dev,
-        )
-        keys_parts.append(k)
+        codes = tuple(chunk[f"{c}::codes"] for c in columns)
+        masks = tuple(chunk[f"{c}::mask"] for c in columns)
+        if two_lane:
+            k1, k2, ns = key2_fn(codes, masks, rows, sizes1, sizes2)
+            keys_parts.append(k1)
+            keys2_parts.append(k2)
+        else:
+            k, ns = key_fn(codes, masks, rows, sizes_dev)
+            keys_parts.append(k)
         n_sentinel = n_sentinel + ns
 
-    keys = (
-        jnp.concatenate(keys_parts) if len(keys_parts) > 1 else keys_parts[0]
-    )
+    def _joined(parts):
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    keys = _joined(keys_parts)
     n = keys.shape[0]
     padded = 1 << max(1, int(n - 1).bit_length()) if n > 1 else 1
-    if padded != n:
+    pad = padded - n
+    if pad:
         keys = jnp.concatenate(
-            [keys, jnp.full(padded - n, _SENTINEL, dtype=keys.dtype)]
+            [keys, jnp.full(pad, _SENTINEL, dtype=keys.dtype)]
         )
-        n_sentinel = n_sentinel + (padded - n)
+        n_sentinel = n_sentinel + pad
 
-    scalars, group_keys, counts = _finalize_fn()(keys, n_sentinel)
     from deequ_tpu.engine.pack import packed_device_get
 
+    if two_lane:
+        keys2 = _joined(keys2_parts)
+        if pad:
+            keys2 = jnp.concatenate(
+                [keys2, jnp.full(pad, _SENTINEL, dtype=keys2.dtype)]
+            )
+        scalars, group_hi, group_lo, counts = _finalize2_fn()(
+            keys, keys2, n_sentinel
+        )
+        scalars = packed_device_get(scalars)
+        return TwoLaneDeviceFrequencies(
+            plan.columns,
+            scalars,
+            group_hi,
+            group_lo,
+            counts,
+            list(dictionaries),
+            list(sizes),
+            split,
+        )
+
+    scalars, group_keys, counts = _finalize_fn()(keys, n_sentinel)
     scalars = packed_device_get(scalars)
     return DeviceFrequencies(
         plan.columns,
@@ -775,7 +1041,15 @@ def device_spill_frequencies(
         pred = compile_predicate(plan.where, dataset)
         requests += list(pred.requests)
 
+    import jax as _jax
+
+    host_f64 = key_kind == "f64" and _jax.default_backend() != "cpu"
+
     if engine is not None and getattr(engine, "mesh", None) is not None:
+        if host_f64:
+            # the mesh kernel needs the on-device bitcast the TPU X64
+            # rewriter lacks; exactness wins — Arrow fallback
+            raise SpillOverflow("f64 keys need host packing; no mesh path")
         return _sharded_spill_frequencies(
             dataset, plan, engine, column, values_dtype, key_kind, pred
         )
@@ -783,31 +1057,63 @@ def device_spill_frequencies(
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
     chunk_batches = min(CHUNK_BATCHES, nb)
-    key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
 
-    keys_parts = []
-    n_sentinel = jnp.int64(0)
-    n_null = jnp.int64(0)
-    for chunk in dataset.device_scan_chunks(
-        requests,
-        batch_size,
-        chunk_batches=chunk_batches,
-        budget_bytes=config.options().device_cache_bytes,
-    ):
-        rows = chunk[ROW_MASK]
-        if pred is not None:
-            flat = {k: v.reshape(-1) for k, v in chunk.items()}
-            rows = rows & pred.complies(flat).reshape(rows.shape)
-        k, ns, nn = key_fn(
-            chunk[f"{column}::values"], chunk[f"{column}::mask"], rows
+    if host_f64:
+        # u64 keys packed on the HOST (host_f64_u64_keys; the TPU X64
+        # rewriter cannot lower the f64->u64 bitcast — measured r4),
+        # shipped instead of the values: same wire bytes, and the
+        # device sort/segment path below is shared untouched
+        parts, n_sent, n_nul = [], 0, 0
+        for batch in dataset.device_batches(requests, batch_size):
+            rows = np.asarray(batch[ROW_MASK], dtype=bool)
+            if pred is not None:
+                rows = rows & np.asarray(pred.complies(batch), dtype=bool)
+            k, ns, nn = host_f64_u64_keys(
+                batch[f"{column}::values"],
+                np.asarray(batch[f"{column}::mask"], dtype=bool),
+                rows,
+                bool(plan.include_nulls),
+            )
+            parts.append(k)
+            n_sent += ns
+            n_nul += nn
+        host_keys = (
+            np.concatenate(parts) if len(parts) > 1 else parts[0]
         )
-        keys_parts.append(k)
-        n_sentinel = n_sentinel + ns
-        n_null = n_null + nn
+        from deequ_tpu.data.table import add_transfer_bytes
 
-    keys = (
-        jnp.concatenate(keys_parts) if len(keys_parts) > 1 else keys_parts[0]
-    )
+        add_transfer_bytes(host_keys.nbytes)
+        keys = _jax.device_put(host_keys)
+        n_sentinel = jnp.int64(n_sent)
+        n_null = jnp.int64(n_nul)
+    else:
+        key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
+
+        keys_parts = []
+        n_sentinel = jnp.int64(0)
+        n_null = jnp.int64(0)
+        for chunk in dataset.device_scan_chunks(
+            requests,
+            batch_size,
+            chunk_batches=chunk_batches,
+            budget_bytes=config.options().device_cache_bytes,
+        ):
+            rows = chunk[ROW_MASK]
+            if pred is not None:
+                flat = {k: v.reshape(-1) for k, v in chunk.items()}
+                rows = rows & pred.complies(flat).reshape(rows.shape)
+            k, ns, nn = key_fn(
+                chunk[f"{column}::values"], chunk[f"{column}::mask"], rows
+            )
+            keys_parts.append(k)
+            n_sentinel = n_sentinel + ns
+            n_null = n_null + nn
+
+        keys = (
+            jnp.concatenate(keys_parts)
+            if len(keys_parts) > 1
+            else keys_parts[0]
+        )
     # pad to pow2 so the (expensive-to-compile) sort program is shared
     # across datasets whose row counts round the same way
     n = keys.shape[0]
