@@ -1,0 +1,240 @@
+"""Precompile deequ_tpu's fused plans for a schema, ahead of data.
+
+First-EVER XLA:TPU compilation of a big fused profiler plan costs
+~110 s (20-col plan; docs/PERF.md pool 3). The persistent cache
+(``DEEQU_TPU_COMPILE_CACHE``, default ``~/.cache/deequ_tpu_xla``)
+makes it one-time per machine — but without this tool, the FIRST
+production run eats it in full. Run warmup at deploy time instead:
+
+    python tools/warmup.py --like-parquet /path/to/table.parquet
+    python tools/warmup.py --schema '{"price": "float32", "id": "int64",
+                                      "cat": "string"}'
+
+and the first production run's compiles become ~0.1-2 s cache
+deserializations (measured; docs/PERF.md).
+
+What gets compiled is keyed by (analyzer structure, schema kinds,
+batch shape, wire dtypes) — NOT by data values (dictionaries/LUTs ride
+as runtime inputs). The synthetic warm data therefore only has to hit
+the same STATIC decisions production data will:
+
+- batch size (``--batch-size``, default = the engine default);
+- per-column wire dtype: int64 columns whose values all fit int32
+  ship narrowed, so ``--int-width`` picks which program to warm
+  (``both`` warms the two variants);
+- null presence: an all-valid column's mask is synthesized on device
+  (a DIFFERENT program than a shipped mask), so ``--nullable both``
+  (default) warms both.
+
+``--suite`` additionally warms a VerificationSuite-shaped plan
+(completeness/uniqueness/compliance per column) on top of the default
+ColumnProfiler plan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+_KINDS = (
+    "float32", "float64", "int32", "int64", "string", "bool", "timestamp"
+)
+
+
+def _schema_from_parquet(path: str):
+    import pyarrow.dataset as pads
+    import pyarrow as pa
+
+    schema = pads.dataset(path, format="parquet").schema
+    out = {}
+    for name, typ in zip(schema.names, schema.types):
+        if pa.types.is_dictionary(typ):
+            typ = typ.value_type
+        if pa.types.is_floating(typ):
+            out[name] = "float32" if typ.bit_width == 32 else "float64"
+        elif pa.types.is_boolean(typ):
+            out[name] = "bool"
+        elif pa.types.is_integer(typ):
+            out[name] = "int32" if typ.bit_width <= 32 else "int64"
+        elif pa.types.is_string(typ) or pa.types.is_large_string(typ):
+            out[name] = "string"
+        elif pa.types.is_timestamp(typ) or pa.types.is_date(typ):
+            out[name] = "timestamp"
+        else:
+            print(f"  (skipping unsupported column {name}: {typ})")
+    return out
+
+
+def synthetic_dataset(schema, rows: int, nullable: bool, wide_ints: bool,
+                      seed: int = 0, high_card_strings: bool = False):
+    """A dataset matching the schema's STATIC compile decisions.
+    ``high_card_strings`` warms the i32-codes / no-histogram program
+    (dictionary-code wire width and the profiler's low-cardinality
+    histogram gate are both static per column)."""
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(seed)
+    cols = {}
+    null_mask = (
+        (rng.random(rows) < 0.05) if nullable else np.zeros(rows, bool)
+    )
+    for name, kind in schema.items():
+        if kind in ("float32", "float64"):
+            vals = rng.normal(0.0, 1.0, rows).astype(kind)
+            arr = pa.array(vals, mask=null_mask if nullable else None)
+        elif kind in ("int32", "int64"):
+            hi = (1 << 40) if (wide_ints and kind == "int64") else 1 << 20
+            vals = rng.integers(0, hi, rows).astype(kind)
+            arr = pa.array(vals, mask=null_mask if nullable else None)
+        elif kind == "bool":
+            arr = pa.array(
+                rng.random(rows) < 0.5,
+                mask=null_mask if nullable else None,
+            )
+        elif kind == "timestamp":
+            base = np.datetime64("2024-01-01", "us")
+            vals = base + rng.integers(0, 1 << 40, rows).astype(
+                "timedelta64[us]"
+            )
+            arr = pa.array(vals, pa.timestamp("us"),
+                           mask=null_mask if nullable else None)
+        elif kind == "string":
+            # 64 distinct -> i8 codes + the profiler's histogram pass;
+            # 200k distinct -> i32 codes, histogram gate off
+            n_cats = min(200_000, max(rows, 2)) if high_card_strings else 64
+            cats = np.array([f"w{j:06d}" for j in range(n_cats)])
+            vals = cats[rng.integers(0, len(cats), rows)]
+            arr = pa.array(
+                vals, mask=null_mask if nullable else None
+            ).dictionary_encode()
+        else:
+            raise ValueError(f"unknown kind {kind!r} (use one of {_KINDS})")
+        cols[name] = arr
+    return Dataset.from_arrow(pa.table(cols))
+
+
+def warm_once(schema, rows, nullable, wide_ints, suite: bool,
+              high_card_strings: bool = False) -> float:
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    ds = synthetic_dataset(
+        schema, rows, nullable, wide_ints,
+        high_card_strings=high_card_strings,
+    )
+    t0 = time.time()
+    ColumnProfiler.profile(ds)
+    if suite:
+        from deequ_tpu import Check, CheckLevel, VerificationSuite
+
+        check = Check(CheckLevel.ERROR, "warmup")
+        for name, kind in schema.items():
+            check = check.is_complete(name)
+            if kind in ("float32", "float64", "int32", "int64"):
+                check = check.is_non_negative(name)
+            if kind in ("int32", "int64", "string"):
+                check = check.is_unique(name)
+        # compiles key on structure/shapes/dtypes, never values —
+        # the profiler's dataset warms the suite plan equally well
+        VerificationSuite().on_data(ds).add_check(check).run()
+    return time.time() - t0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="precompile deequ_tpu plans for a schema"
+    )
+    parser.add_argument("--schema", help="JSON {column: kind}")
+    parser.add_argument(
+        "--like-parquet", help="read the schema from a parquet file/dir"
+    )
+    parser.add_argument("--batch-size", type=int, default=None)
+    parser.add_argument(
+        "--nullable", choices=("none", "all", "both"), default="both"
+    )
+    parser.add_argument(
+        "--int-width", choices=("narrow", "wide", "both"), default="both"
+    )
+    parser.add_argument(
+        "--string-cardinality",
+        choices=("low", "high", "both"),
+        default="low",
+        help="low: i8 codes + histogram pass; high: i32 codes, no "
+        "histogram (two different compiled programs)",
+    )
+    parser.add_argument(
+        "--suite", action="store_true",
+        help="also warm a VerificationSuite-shaped plan",
+    )
+    args = parser.parse_args()
+
+    if bool(args.schema) == bool(args.like_parquet):
+        parser.error("exactly one of --schema / --like-parquet")
+    schema = (
+        json.loads(args.schema)
+        if args.schema
+        else _schema_from_parquet(args.like_parquet)
+    )
+    if not schema:
+        parser.error(
+            "schema is empty (no supported columns) — nothing to warm"
+        )
+    for kind in schema.values():
+        if kind not in _KINDS:
+            parser.error(f"unknown kind {kind!r} (use one of {_KINDS})")
+    print(f"schema: {schema}")
+
+    from deequ_tpu import config
+    from deequ_tpu.engine.scan import DEFAULT_MAX_BATCH
+
+    batch = args.batch_size or config.options().batch_size or DEFAULT_MAX_BATCH
+    # ONE batch of warm rows: compiles are shape-keyed, so more adds
+    # nothing; engines resolve batch_size = min(rows, default), so the
+    # warm row count must equal the production batch size exactly
+    rows = batch
+    nullables = {
+        "none": (False,), "all": (True,), "both": (False, True)
+    }[args.nullable]
+    widths = {
+        "narrow": (False,), "wide": (True,), "both": (False, True)
+    }[args.int_width]
+    cards = {
+        "low": (False,), "high": (True,), "both": (False, True)
+    }[args.string_cardinality]
+    has_int64 = any(k == "int64" for k in schema.values())
+    has_string = any(k == "string" for k in schema.values())
+    with config.configure(batch_size=batch):
+        total = 0.0
+        for nullable in nullables:
+            for wide in widths if has_int64 else (False,):
+                for high_card in cards if has_string else (False,):
+                    t = warm_once(
+                        schema, rows, nullable, wide, args.suite,
+                        high_card_strings=high_card,
+                    )
+                    total += t
+                    print(
+                        f"  warmed nullable={nullable} "
+                        f"wide_ints={wide} "
+                        f"high_card_strings={high_card}: {t:.1f}s"
+                    )
+    print(
+        f"done in {total:.1f}s — plans persisted to "
+        f"{config.options().compilation_cache_dir}; the first "
+        "production run now deserializes instead of compiling"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
