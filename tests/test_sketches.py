@@ -260,3 +260,69 @@ class TestKLLRegressions:
             analyzer
         ).value.get()
         assert got == pytest.approx(want, rel=1e-9)
+
+
+class TestKLLAdversarial:
+    """docs/KLL_ERROR.md §4: the strided-batch compaction's O(1/k)
+    bound must hold for adversarial ORDERINGS and degenerate value
+    distributions, not just i.i.d. uniform — a broken random offset,
+    boundary weight loss, or order sensitivity would blow the
+    envelope on at least one of these."""
+
+    N = 400_000
+    QS = tuple(round(q / 100, 2) for q in range(1, 100))
+
+    def _max_rank_error(self, vals: np.ndarray) -> float:
+        from deequ_tpu.sketches.kll import DEFAULT_SKETCH_SIZE as k
+
+        ds = Dataset.from_pydict({"x": vals})
+        engine = AnalysisEngine(batch_size=65_536)
+        analyzer = ApproxQuantiles("x", self.QS)
+        ctx = AnalysisRunner.do_analysis_run(ds, [analyzer], engine=engine)
+        result = value(ctx.metric(analyzer))
+        hi = np.sort(vals)
+        n = len(vals)
+        worst = 0.0
+        for q in self.QS:
+            est = result[str(q)]
+            # the estimate's rank in the TRUE data is an INTERVAL
+            # (value plateaus hold many ranks); the sketch is correct
+            # if the target rank falls inside it, and its error is the
+            # distance to the interval otherwise
+            lo = np.searchsorted(hi, est, side="left")
+            rhi = np.searchsorted(hi, est, side="right")
+            target = q * n
+            err = max(lo - target, target - rhi, 0.0)
+            worst = max(worst, err)
+        assert worst <= 3 * n / k, worst
+        return worst
+
+    def test_sorted_input(self):
+        self._max_rank_error(np.arange(self.N, dtype=np.float64))
+
+    def test_reverse_sorted_input(self):
+        self._max_rank_error(np.arange(self.N, dtype=np.float64)[::-1])
+
+    def test_constant_heavy(self):
+        rng = np.random.default_rng(7)
+        vals = np.where(
+            rng.random(self.N) < 0.9, 42.0, rng.normal(0, 1, self.N)
+        )
+        # ranks are ambiguous across a 90% constant plateau; check the
+        # plateau's quantiles resolve to the constant and the tails
+        # stay in-envelope via the generic check on the mixed data
+        self._max_rank_error(np.sort(vals))
+
+    def test_organ_pipe_ordering(self):
+        # small/large interleaved: worst case for sequential samplers
+        a = np.arange(self.N, dtype=np.float64)
+        pipe = np.empty(self.N)
+        pipe[0::2] = a[: self.N // 2]
+        pipe[1::2] = a[self.N // 2:][::-1]
+        self._max_rank_error(pipe)
+
+    def test_few_distinct(self):
+        rng = np.random.default_rng(9)
+        self._max_rank_error(
+            rng.integers(0, 5, self.N).astype(np.float64)
+        )
