@@ -64,35 +64,43 @@ def _to_host(value):
 
 
 class FileSystemStateProvider(StateLoader, StatePersister):
-    """Binary state serde to a directory (reference: HdfsStateProvider —
-    local/HDFS/S3 via Hadoop FS; here any mounted filesystem path)."""
+    """Binary state serde to a directory OR storage URI (reference:
+    HdfsStateProvider — local/HDFS/S3 via the Hadoop FS registry; here
+    plain paths use the local filesystem and ``scheme://`` URIs route
+    through deequ_tpu.io.storage's backend registry — ``mem://`` ships
+    in-tree, cloud backends register in a few lines)."""
 
     def __init__(self, path: str, allow_overwrite: bool = True):
+        from deequ_tpu.io.storage import storage_for
+
         self._path = path
         self._allow_overwrite = allow_overwrite
-        os.makedirs(path, exist_ok=True)
-        self._index_path = os.path.join(path, "index.json")
+        self._storage = storage_for(path)
 
-    def _filename(self, analyzer: Analyzer) -> str:
+    def _key(self, analyzer: Analyzer) -> str:
         digest = hashlib.sha1(repr(analyzer).encode()).hexdigest()[:16]
-        return os.path.join(self._path, f"state-{digest}.npz")
+        return f"state-{digest}.npz"
 
-    def _update_index(self, analyzer: Analyzer, filename: str) -> None:
+    def _update_index(self, analyzer: Analyzer, key: str) -> None:
         index: Dict[str, str] = {}
-        if os.path.exists(self._index_path):
-            with open(self._index_path) as fh:
-                index = json.load(fh)
-        index[repr(analyzer)] = os.path.basename(filename)
-        with open(self._index_path, "w") as fh:
-            json.dump(index, fh, indent=2)
+        raw = self._storage.read_bytes("index.json")
+        if raw is not None:
+            index = json.loads(raw.decode())
+        index[repr(analyzer)] = key
+        self._storage.write_bytes(
+            "index.json", json.dumps(index, indent=2).encode()
+        )
 
     def persist(self, analyzer: Analyzer, state: Any) -> None:
-        filename = self._filename(analyzer)
-        if not self._allow_overwrite and os.path.exists(filename):
-            raise FileExistsError(filename)
+        import io as _io
+
+        key = self._key(analyzer)
+        if not self._allow_overwrite and self._storage.exists(key):
+            raise FileExistsError(f"{self._path}/{key}")
+        buf = _io.BytesIO()
         if isinstance(state, FrequenciesAndNumRows):
             np.savez(
-                filename,
+                buf,
                 __type__=np.asarray("FrequenciesAndNumRows"),
                 columns=np.asarray(json.dumps(list(state.columns))),
                 keys=np.asarray(
@@ -103,7 +111,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             )
         elif isinstance(state, KLLSketchState):
             np.savez(
-                filename,
+                buf,
                 __type__=np.asarray("KLLSketchState"),
                 **state.to_arrays(),
             )
@@ -114,7 +122,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 for field in state._fields
             }
             np.savez(
-                filename,
+                buf,
                 __type__=np.asarray(name),
                 __version__=np.int64(STATE_FORMAT_VERSIONS.get(name, 1)),
                 **payload,
@@ -123,13 +131,16 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             raise TypeError(
                 f"cannot persist state of type {type(state).__name__}"
             )
-        self._update_index(analyzer, filename)
+        self._storage.write_bytes(key, buf.getvalue())
+        self._update_index(analyzer, key)
 
     def load(self, analyzer: Analyzer) -> Optional[Any]:
-        filename = self._filename(analyzer)
-        if not os.path.exists(filename):
+        import io as _io
+
+        raw = self._storage.read_bytes(self._key(analyzer))
+        if raw is None:
             return None
-        with np.load(filename, allow_pickle=False) as data:
+        with np.load(_io.BytesIO(raw), allow_pickle=False) as data:
             type_name = str(data["__type__"])
             if type_name == "FrequenciesAndNumRows":
                 columns = tuple(json.loads(str(data["columns"])))

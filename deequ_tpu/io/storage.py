@@ -1,0 +1,171 @@
+"""Pluggable byte storage for state providers and metric repositories.
+
+Reference: deequ's ``HdfsStateProvider`` / repository paths accept any
+Hadoop filesystem URI — S3, HDFS, local — resolved by the FileSystem
+registry (SURVEY.md §2.2 StateProvider row; VERDICT r3 missing #5).
+This module is the TPU-stack analog: a minimal :class:`Storage`
+protocol (atomic-visibility writes, reads, listing) plus a URI-scheme
+registry, so ``FileSystemStateProvider("s3://bucket/states")`` routes
+through whatever backend the deployment registers, while plain local
+paths keep the direct, zero-overhead os-path implementation.
+
+Backends in-tree:
+
+- ``LocalStorage`` — the default for plain paths and ``file://``;
+  writes are temp-file + ``os.replace`` (atomic visibility, matching
+  the repository/table.py discipline);
+- ``MemoryStorage`` (``mem://``) — an in-process dict, used by tests
+  to exercise every remote-path branch without a cloud SDK, and handy
+  as a scratch repository.
+
+Cloud SDKs are not baked into this image, so S3/GCS/HDFS classes are
+NOT shipped; deployments register one in a few lines:
+
+    from deequ_tpu.io.storage import Storage, register_storage_scheme
+
+    class S3Storage(Storage):
+        def __init__(self, uri): ...  # boto3 client
+        ...
+
+    register_storage_scheme("s3", S3Storage)
+
+after which every state provider / repository accepts ``s3://`` URIs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class Storage:
+    """Byte-blob storage under a base location. Keys are '/'-relative
+    names (no scheme); implementations must give ``write_bytes``
+    atomic VISIBILITY (a concurrent ``read_bytes``/``list_keys`` sees
+    either the whole blob or nothing)."""
+
+    def read_bytes(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        return self.read_bytes(key) is not None
+
+
+class LocalStorage(Storage):
+    """Plain directory storage (the default)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _full(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/"))
+
+    def read_bytes(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._full(key), "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        full = self._full(key)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = f"{full}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, full)  # atomic visibility
+        finally:
+            if os.path.exists(tmp):  # failed write: no orphan
+                os.unlink(tmp)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in files:
+                rel = os.path.relpath(
+                    os.path.join(dirpath, name), self.root
+                ).replace(os.sep, "/")
+                # skip this class's own in-flight temps
+                # (<key>.tmp.<pid>.<tid>) and bare .tmp files
+                if ".tmp." in rel or rel.endswith(".tmp"):
+                    continue
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._full(key))
+
+
+class MemoryStorage(Storage):
+    """In-process storage (``mem://name``): one shared namespace per
+    URI, thread-safe — the remote-backend stand-in for tests."""
+
+    _spaces: Dict[str, Dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, uri: str):
+        name = uri.split("://", 1)[1]
+        with MemoryStorage._lock:
+            self._blobs = MemoryStorage._spaces.setdefault(name, {})
+
+    def read_bytes(self, key: str) -> Optional[bytes]:
+        with MemoryStorage._lock:
+            return self._blobs.get(key)
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        with MemoryStorage._lock:
+            self._blobs[key] = bytes(data)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        with MemoryStorage._lock:
+            return sorted(
+                k for k in self._blobs if k.startswith(prefix)
+            )
+
+    def exists(self, key: str) -> bool:
+        with MemoryStorage._lock:
+            return key in self._blobs
+
+
+_SCHEMES: Dict[str, Callable[[str], Storage]] = {}
+
+
+def register_storage_scheme(
+    scheme: str, factory: Callable[[str], Storage]
+) -> None:
+    """Register ``factory(uri) -> Storage`` for ``scheme://`` URIs."""
+    _SCHEMES[scheme.lower()] = factory
+
+
+register_storage_scheme("mem", MemoryStorage)
+register_storage_scheme(
+    "file", lambda uri: LocalStorage(uri.split("://", 1)[1])
+)
+
+
+def storage_for(path_or_uri: str) -> Storage:
+    """Resolve a path/URI to a Storage backend: plain paths use
+    LocalStorage; ``scheme://`` URIs dispatch through the registry,
+    with a deployment-pointing error for unregistered schemes."""
+    if "://" in path_or_uri:
+        scheme = path_or_uri.split("://", 1)[0].lower()
+        factory = _SCHEMES.get(scheme)
+        if factory is None:
+            raise ValueError(
+                f"no storage backend registered for {scheme}://; "
+                "register one via deequ_tpu.io.storage."
+                "register_storage_scheme (see the module docstring "
+                "for the S3 sketch)"
+            )
+        return factory(path_or_uri)
+    return LocalStorage(path_or_uri)
