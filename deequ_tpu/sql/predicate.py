@@ -35,6 +35,8 @@ SURVEY.md §2.2 Compliance = "arbitrary SQL predicate"):
 | TRIM/LTRIM/RTRIM(s) | host transform over the dictionary |
 | UPPER(s) / LOWER(s) | compose freely, e.g. UPPER(TRIM(s)) |
 | SUBSTR/SUBSTRING(s, pos[, len]) | Spark 1-based semantics |
+| CONCAT(...) | at most one column operand, literals around it |
+| CAST(x AS INT/BIGINT/DOUBLE/...) | numeric targets; string operands parse per dictionary entry, unparseable -> NULL |
 | ts_col <op> 'YYYY-MM-DD[ HH:MM:SS]' | date literal in the column's unit |
 | literals | numbers, 'strings', TRUE/FALSE/NULL |
 
@@ -46,8 +48,8 @@ the runner degrades to that analyzer's failure metric — never a crash
 mid-scan.
 
 Known not-yet-implemented vs full Spark SQL (documented, degrade
-cleanly): string-valued CASE/COALESCE results, CONCAT, date arithmetic
-(date_add/datediff), casts.
+cleanly): string-valued CASE/COALESCE results, multi-column CONCAT,
+CAST to STRING, date arithmetic (date_add/datediff).
 """
 
 from __future__ import annotations
@@ -79,7 +81,7 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "AND", "OR", "NOT", "IS", "NULL", "IN", "BETWEEN", "LIKE", "RLIKE",
-    "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
+    "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "AS",
 }
 
 
@@ -198,6 +200,16 @@ class CaseWhen(Node):
 
     whens: Tuple[Tuple[Node, Node], ...]
     else_: Optional[Node]
+
+
+@dataclass(frozen=True)
+class Cast(Node):
+    """CAST(expr AS type); numeric targets only (INT truncates toward
+    zero; string operands parse per dictionary entry, unparseable ->
+    NULL, Spark's cast semantics)."""
+
+    operand: Node
+    type_name: str  # 'INT' | 'BIGINT' | 'LONG' | 'FLOAT' | 'DOUBLE'
 
 
 @dataclass(frozen=True)
@@ -347,6 +359,17 @@ class _Parser:
 
     def primary(self) -> Node:
         tok = self.next()
+        if tok.kind == "kw" and tok.text == "CAST":
+            self.expect("op", "(")
+            operand = self.or_expr()
+            self.expect("kw", "AS")
+            type_tok = self.next()
+            if type_tok.kind != "ident":
+                raise PredicateParseError(
+                    f"CAST expects a type name, got {type_tok.text!r}"
+                )
+            self.expect("op", ")")
+            return Cast(operand, type_tok.text.upper())
         if tok.kind == "kw" and tok.text == "CASE":
             whens: List[Tuple[Node, Node]] = []
             while self.accept("kw", "WHEN"):
@@ -631,15 +654,62 @@ def _check_types(node: Node, schema) -> str:
             if kind_of(n.operand) != "string":
                 raise PredicateParseError("LIKE requires a string column")
             return "value"
+        if isinstance(n, Cast):
+            if n.type_name not in _CAST_TYPES:
+                raise PredicateParseError(
+                    f"CAST to {n.type_name} is not supported "
+                    "(numeric targets only)"
+                )
+            k = kind_of(n.operand)
+            if k == "stringlit":
+                raise PredicateParseError(
+                    "CAST of a string literal is constant"
+                )
+            if k == "timestamp":
+                # raw epoch values are in the STORAGE unit (us/ns/...);
+                # Spark's cast(timestamp as bigint) yields SECONDS —
+                # returning unit-dependent numbers would be silently
+                # wrong, so refuse (compare against date literals
+                # instead, which convert through the column's unit)
+                raise PredicateParseError(
+                    "CAST of a timestamp column is not supported — "
+                    "compare against 'YYYY-MM-DD' literals instead"
+                )
+            return "value"
         if isinstance(n, FuncCall):
             # the predicate evaluator supports only these functions;
             # aggregates (SUM/COUNT/...) belong to CustomSql expressions
             # and must fail HERE (planning time), not mid-trace where
             # they would poison every co-scheduled analyzer
-            if n.name not in ("ABS", "LENGTH", "COALESCE") + _STRING_FNS:
+            if n.name not in (
+                "ABS", "LENGTH", "COALESCE", "CONCAT",
+            ) + _STRING_FNS:
                 raise PredicateParseError(
                     f"unsupported function {n.name} in a predicate"
                 )
+            if n.name == "CONCAT":
+                if not n.args:
+                    raise PredicateParseError("CONCAT needs arguments")
+                col_args = 0
+                for a in n.args:
+                    k = kind_of(a)
+                    if k == "string":
+                        col_args += 1
+                    elif k != "stringlit":
+                        raise PredicateParseError(
+                            "CONCAT arguments must be strings"
+                        )
+                if col_args == 0:
+                    raise PredicateParseError(
+                        "CONCAT of only literals is constant"
+                    )
+                if col_args > 1:
+                    raise PredicateParseError(
+                        "CONCAT supports at most ONE column operand "
+                        "(cross-dictionary concatenation is not "
+                        "supported)"
+                    )
+                return "string"
             for a in n.args:
                 if isinstance(a, StarLit):
                     raise PredicateParseError(
@@ -878,6 +948,11 @@ def _rank_lut_with_literal(dataset: Dataset, base: "_Val", literal: str):
 
 _STRING_FNS = ("TRIM", "LTRIM", "RTRIM", "UPPER", "LOWER", "SUBSTR",
                "SUBSTRING")
+_CAST_TYPES = (
+    "INT", "INTEGER", "BIGINT", "LONG", "SMALLINT", "TINYINT",
+    "FLOAT", "DOUBLE", "REAL",
+)
+_INT_CASTS = ("INT", "INTEGER", "BIGINT", "LONG", "SMALLINT", "TINYINT")
 
 
 def _static_int(node: Node, what: str) -> int:
@@ -1029,6 +1104,34 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             batch,
             ds,
         )
+    if isinstance(node, Cast):
+        v = _eval(node.operand, batch, ds)
+        integral = node.type_name in _INT_CASTS
+        if v.codes_of is not None:
+            # string column: parse each dictionary entry ONCE
+            # (Spark cast semantics: unparseable -> NULL)
+            dictionary = ds.dictionary(v.codes_of)
+            table = np.full(len(dictionary) + 1, np.nan)
+            for i, s in enumerate(dictionary):
+                if s is not None:
+                    text = v.view(str(s)).strip()
+                    if "_" in text:  # Python-only numeric syntax
+                        continue  # ('1_0'); Spark casts it to NULL
+                    try:
+                        table[i] = float(text)
+                    except ValueError:
+                        pass
+            lut = jnp.asarray(table)
+            idx = jnp.where(v.values < 0, len(dictionary), v.values)
+            vals = lut[jnp.clip(idx, 0, len(dictionary))]
+            valid = v.valid & ~jnp.isnan(vals)
+            vals = jnp.where(valid, vals, 0.0)
+        else:
+            vals = v.values.astype(jnp.float64)
+            valid = v.valid
+        if integral:
+            vals = jnp.trunc(vals)  # toward zero; NaN values propagate
+        return _Val(vals, valid)
     if isinstance(node, CaseWhen):
         # SQL: first branch whose condition is TRUE wins (NULL
         # conditions skip); no match and no ELSE -> NULL. Folded in
@@ -1146,6 +1249,43 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             idx = jnp.where(v.values < 0, len(dictionary), v.values)
             return _Val(
                 lut[jnp.clip(idx, 0, len(dictionary))], v.valid
+            )
+        if node.name == "CONCAT":
+            # at most ONE column operand (checked at plan time):
+            # literals fold into the transform around it
+            col_val = None
+            parts = []
+            for a in node.args:
+                if isinstance(a, StringLit):
+                    parts.append(a.value)
+                else:
+                    v = _eval(a, batch, ds)
+                    if v.codes_of is None:
+                        raise PredicateParseError(
+                            "CONCAT arguments must be strings"
+                        )
+                    if col_val is not None:
+                        raise PredicateParseError(
+                            "CONCAT supports at most ONE column operand"
+                        )
+                    col_val = v
+                    parts.append(None)  # the column slot
+            if col_val is None:
+                raise PredicateParseError(
+                    "CONCAT of only literals is constant"
+                )
+            inner = col_val.view
+
+            def transform(s, _parts=tuple(parts), _inner=inner):
+                return "".join(
+                    _inner(s) if p is None else p for p in _parts
+                )
+
+            return _Val(
+                col_val.values,
+                col_val.valid,
+                codes_of=col_val.codes_of,
+                transform=transform,
             )
         if node.name in _STRING_FNS:
             return _eval_string_fn(node, batch, ds)

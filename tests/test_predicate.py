@@ -197,7 +197,7 @@ class TestR4GrammarExtensions:
 
     def test_unsupported_degrade_to_failure_metric(self, strings_ds):
         for bad in (
-            "CONCAT(s, 'x') = 'yx'",  # unsupported function
+            "DATE_ADD(s, 1) = 'yx'",  # unsupported function
             "CASE WHEN x > 0 THEN s ELSE s END = 'a'",  # string CASE
             "COALESCE(s, 'z') = 'z'",  # string COALESCE
             "TRIM(x) = 'a'",  # TRIM of numeric
@@ -276,3 +276,69 @@ class TestR4GrammarExtensions:
         cols = [n for n in rl.schema.names if "Minimum" in n]
         assert cols, rl.schema.names  # column present, not dropped
         assert rl.column(cols[0]).to_pylist() == [True, True, True]
+
+    def test_concat_and_cast(self, strings_ds):
+        # CONCAT: one column + literals, composing with transforms
+        assert compliance(
+            strings_ds, "CONCAT('<', TRIM(s), '>') = '<banana>'"
+        ) == 0.2
+        assert compliance(
+            strings_ds, "CONCAT(LOWER(s), '!') LIKE '%y!'"
+        ) == 0.2  # CHERRY -> cherry!
+        # CAST numeric
+        assert compliance(strings_ds, "CAST(x AS INT) = 3") == 0.2
+        assert compliance(
+            strings_ds, "CAST(y / 3 AS INT) = 3"
+        ) == 0.2  # 10/3 -> 3
+        # CAST string column to number: parse per dictionary entry
+        ds = Dataset.from_pydict(
+            {"s": ["1.5", "2", "x", None, " 3 "]}
+        )
+        assert compliance(ds, "CAST(s AS DOUBLE) >= 1.5") == pytest.approx(
+            3 / 5
+        )
+        assert compliance(ds, "CAST(s AS INT) = 1") == 0.2  # trunc(1.5)
+        # unparseable -> NULL -> IS NULL sees it
+        assert compliance(
+            ds, "CAST(s AS DOUBLE) IS NULL"
+        ) == pytest.approx(2 / 5)  # 'x' and the real null
+
+    def test_concat_cast_plan_time_failures(self, strings_ds):
+        from deequ_tpu.analyzers import AnalysisRunner
+
+        bads = [
+            Compliance("c1", "CONCAT('a', 'b') = 'ab'"),  # constant
+            Compliance("c2", "CONCAT(s, s) = 'aa'"),  # two columns
+            Compliance("c3", "CAST(s AS STRING) = 'a'"),  # string target
+            Compliance("c4", "CAST(x AS BANANA) = 1"),  # unknown type
+        ]
+        good = Mean("x")
+        ctx = AnalysisRunner.do_analysis_run(strings_ds, bads + [good])
+        assert ctx.metric(good).value.is_success
+        for bad in bads:
+            assert ctx.metric(bad).value.is_failure, bad
+
+    def test_cast_review_regressions(self):
+        from deequ_tpu.analyzers import AnalysisRunner
+        import datetime
+
+        # underscore numeric syntax is Python-only; Spark -> NULL
+        ds = Dataset.from_pydict({"s": ["1_0", "10"]})
+        assert compliance(ds, "CAST(s AS DOUBLE) = 10") == 0.5
+        assert compliance(ds, "CAST(s AS DOUBLE) IS NULL") == 0.5
+        # timestamp CAST refuses at plan time (unit-dependent epochs)
+        ts = Dataset.from_arrow(
+            pa.table(
+                {
+                    "t": pa.array(
+                        [datetime.datetime(2024, 1, 1)], pa.timestamp("us")
+                    ),
+                    "x": pa.array([1.0]),
+                }
+            )
+        )
+        bad = Compliance("c", "CAST(t AS BIGINT) = 1")
+        good = Mean("x")
+        ctx = AnalysisRunner.do_analysis_run(ts, [bad, good])
+        assert ctx.metric(bad).value.is_failure
+        assert ctx.metric(good).value.is_success
