@@ -280,6 +280,57 @@ def cases():
     add("strings", type="PatternMatch", column="s", pattern="^ab")
     add("strings", type="Completeness", column="s")
     add("strings", type="DataType", column="s")
+    # SQL three-valued logic, frozen as goldens (a predicate-compiler
+    # regression must not silently shift Compliance values):
+    # rows: x = [1.0, NULL, 3.0, NULL], grp = a a b b
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="null-cmp",
+        predicate="x > 0",  # NULL rows are not compliant
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="null-or",
+        predicate="x > 0 OR grp = 'a'",  # TRUE OR NULL = TRUE
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="null-and-false",
+        predicate="x > 99 AND grp = 'zz'",  # FALSE AND NULL = FALSE
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="div-zero",
+        predicate="x / (x - x) > 0",  # division by zero -> NULL
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="in-null",
+        predicate="x IN (1, NULL)",  # match TRUE, else NULL
+    )
+    add(
+        "count_col_vs_star",
+        type="Compliance",
+        instance="is-null",
+        predicate="x IS NULL",
+    )
+    add(
+        "strings",
+        type="Compliance",
+        instance="like-null",
+        predicate="s LIKE 'ab%'",  # null rows not compliant
+    )
+    add(
+        "strings",
+        type="Compliance",
+        instance="len-empty",
+        predicate="LENGTH(s) = 0",  # empty string is NOT null
+    )
     return c
 
 
