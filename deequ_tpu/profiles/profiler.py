@@ -30,6 +30,7 @@ import pyarrow as pa
 
 from deequ_tpu.analyzers import (
     AnalysisRunner,
+    AnalyzerContext,
     ApproxCountDistinct,
     ApproxQuantiles,
     Completeness,
@@ -132,11 +133,32 @@ class ColumnProfiler:
             c for c in columns if data.schema.kind_of(c).is_numeric
         ]
         pass1: List = [Size()]
+        # string/bool columns whose dictionary is provably small get
+        # their histogram SPECULATIVELY in pass 1: the dictionary is
+        # built for codes anyway (HLL/DataType request them), the dense
+        # frequency counts fuse into the same scan, and the histogram
+        # pass below then usually has nothing left — ONE streamed read
+        # of the source instead of two (the 1B-row workload can only
+        # ever run streamed). The probe bails early for big
+        # dictionaries, and the ATTACH gate below stays the reference's
+        # approx-distinct test, so which histograms ship is unchanged.
+        pass1_histograms: List[str] = []
+        for c in columns:
+            if data.schema.kind_of(c) in (Kind.STRING, Kind.BOOLEAN):
+                try:
+                    size = data.dictionary_size_within(
+                        c, low_cardinality_histogram_threshold
+                    )
+                except Exception:  # noqa: BLE001 — odd column: pass 3
+                    size = None
+                if size is not None:
+                    pass1_histograms.append(c)
         for c in columns:
             pass1.append(Completeness(c))
             pass1.append(ApproxCountDistinct(c))
             if data.schema.kind_of(c) == Kind.STRING:
                 pass1.append(DataType(c))
+        pass1 += [Histogram(c) for c in pass1_histograms]
         pass1 += numeric_analyzers(numeric_native)
         ctx1 = AnalysisRunner.do_analysis_run(data, pass1, engine=engine)
 
@@ -190,23 +212,33 @@ class ColumnProfiler:
             ctx2 = ctx1 + promoted_ctx
 
         # ---- PASS 3: histograms for low-cardinality columns ----------
-        # (ALL histograms share one scan via compute_many_frequencies)
+        # (ALL histograms share one scan via compute_many_frequencies;
+        # columns speculatively histogrammed in pass 1 are excluded, so
+        # this pass usually only remains for low-cardinality INTEGER
+        # columns — the gate itself is unchanged from the reference)
         histogram_columns = [
             c
             for c in columns
             if approx_distinct[c] <= low_cardinality_histogram_threshold
             and kinds[c] in (Kind.STRING, Kind.BOOLEAN, Kind.INTEGRAL)
         ]
-        ctx3 = AnalysisRunner.do_analysis_run(
-            data, [Histogram(c) for c in histogram_columns], engine=engine
-        )
+        pass3_columns = [
+            c for c in histogram_columns if c not in pass1_histograms
+        ]
+        if pass3_columns:
+            ctx3 = AnalysisRunner.do_analysis_run(
+                data, [Histogram(c) for c in pass3_columns], engine=engine
+            )
+        else:
+            ctx3 = AnalyzerContext({})
 
         # ---- assemble -------------------------------------------------
         profiles: Dict[str, StandardColumnProfile] = {}
         for c in columns:
             histogram = None
-            if c in histogram_columns:
-                metric = ctx3.metric(Histogram(c))
+            if c in histogram_columns:  # the reference's approx gate
+                source = ctx1 if c in pass1_histograms else ctx3
+                metric = source.metric(Histogram(c))
                 if metric is not None and metric.value.is_success:
                     histogram = metric.value.get()
             base = dict(

@@ -114,11 +114,19 @@ class TestRunMetadata:
         profiles = ColumnProfiler.profile(ds)
         meta = profiles.run_metadata
         assert meta is not None
-        # fused pass 1 (generic + native-numeric stats) + the histogram
-        # run (its own fused scan — histogram COLUMN selection depends
-        # on pass 1's cardinalities, so it cannot merge into pass 1)
+        # r4: the string column's histogram rides pass 1 (its small
+        # dictionary is known up front), so the WHOLE profile is ONE
+        # fused scan — one streamed read of the source
         names = [p.name for p in meta.passes]
-        assert names == ["scan", "scan"]
+        assert names == ["scan"]
+
+        # an INTEGER low-cardinality column still needs the separate
+        # histogram pass (its cardinality is only known after pass 1)
+        ds2 = Dataset.from_pydict(
+            {"x": list(np.arange(500.0)), "k": [1, 2, 3, 4] * 125}
+        )
+        meta2 = ColumnProfiler.profile(ds2).run_metadata
+        assert [p.name for p in meta2.passes] == ["scan", "scan"]
 
 
 class TestPlanCache:

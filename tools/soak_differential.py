@@ -6,7 +6,7 @@ suite (minutes of wall time); run manually before a release:
 
     python tools/soak_differential.py
 
-Last run (round 3): 0 failures over 200 seeds.
+Last run (round 4): 0 failures over 200 seeds.
 """
 
 import sys, traceback
