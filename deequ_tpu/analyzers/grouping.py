@@ -325,7 +325,6 @@ def plan_frequency_passes(
             remaining -= padded
         elif (
             len(plan.columns) > 1
-            and (engine is None or engine.mesh is None)
             # size-independent gates FIRST: the full-cardinality
             # re-probe below may stream a whole distinct set into host
             # memory, which must never happen for a config-rejected plan
@@ -359,9 +358,15 @@ def plan_frequency_passes(
 
             def make_joint(plan, dictionaries, sizes):
                 def run():
-                    result = spill_mod.device_spill_joint_frequencies(
-                        dataset, plan, engine, dictionaries, sizes
-                    )
+                    try:
+                        result = spill_mod.device_spill_joint_frequencies(
+                            dataset, plan, engine, dictionaries, sizes
+                        )
+                    except spill_mod.SpillOverflow:
+                        # a sharded hash bucket exceeded its static
+                        # capacity: exactness wins, host path instead
+                        note(plan, "host-arrow-overflow")
+                        return _arrow_frequencies(dataset, plan)
                     note(plan, "device-sort-joint")  # after success
                     return result
 

@@ -558,6 +558,11 @@ class DeviceFrequencies(FrequenciesAndNumRows):
             live = raw_counts > 0  # drops a zeroed sentinel segment
             self._keys_host = raw_keys[live]
             self._counts_host = raw_counts[live].astype(np.int64)
+        self._set_joint_lazy()
+
+    def _set_joint_lazy(self) -> None:
+        """Arm the base class's cached joint decode over the fetched
+        keys (shared by the single-device and sharded fetches)."""
         if self._joint is not None and self._lazy is None:
             dictionaries, sizes = self._joint
             self._lazy = (
@@ -796,8 +801,11 @@ class ShardedDeviceFrequencies(DeviceFrequencies):
             self._counts_host = np.concatenate(count_parts).astype(
                 np.int64
             )
+        self._set_joint_lazy()
 
     def top_groups(self, k: int):
+        if self._joint is not None:  # multi-column: host decode path
+            return FrequenciesAndNumRows.top_groups(self, k)
         # host-side top-k over the fetched union (a per-shard device
         # top_k + gather would cut the fetch further; at histogram's
         # k<=1000 the union fetch is the simpler exact path)
@@ -899,10 +907,129 @@ def joint_spill_eligible(
     """Multi-column variant: config gates pass AND the joint
     mixed-radix key space fits the sort lanes (one u64 lane below
     2^62; past that, TWO lanes cover up to ~2^124 provided the digits
-    split across lanes)."""
+    split across lanes — single-device only; the meshed shuffle
+    requires the one-lane shape)."""
     if not joint_spill_config_ok(dataset, plan, engine):
         return False
+    if engine is not None and getattr(engine, "mesh", None) is not None:
+        return joint_fits_one_lane(sizes)
     return split_joint_lanes(tuple(sizes)) is not None
+
+
+def joint_fits_one_lane(sizes) -> bool:
+    """True when the mixed-radix joint space fits ONE u64 sort lane
+    (< 2^62): the shape the sharded shuffle can re-use unchanged.
+    Defined via split_joint_lanes so there is exactly one copy of the
+    lane-capacity rule."""
+    return split_joint_lanes(tuple(sizes)) == len(tuple(sizes))
+
+
+def _sharded_shuffle(dataset, engine, needed, build, label: str):
+    """Shared mesh-spill scaffolding (the ONE copy): pow2/mesh-multiple
+    padding (so the per-shard sort's expensive-to-compile program is
+    shared across datasets whose row counts round the same way),
+    column staging, the bucketed all_to_all shuffle, and the overflow
+    check. ``build(flat)`` -> (keys, n_sentinel, n_null).
+
+    Returns (scalars, g_keys, g_counts, segs_host, n_null_host);
+    raises SpillOverflow when a hash bucket exceeds its static
+    capacity (the caller falls back to Arrow)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deequ_tpu.engine.pack import packed_device_get
+
+    mesh, axis = engine.mesh, engine.dp_axis
+    ndev = mesh.shape[axis]
+    n = dataset.num_rows
+    pow2 = 1 << max(1, int(max(n, 1) - 1).bit_length())
+    padded = max(1, -(-pow2 // ndev)) * ndev
+    sharding = NamedSharding(mesh, P(axis))
+
+    def pad_to(host: np.ndarray) -> np.ndarray:
+        if len(host) < padded:
+            host = np.concatenate(
+                [host, np.zeros(padded - len(host), dtype=host.dtype)]
+            )
+        return host
+
+    flat = {
+        r.key: jax.device_put(pad_to(dataset.materialize(r)), sharding)
+        for r in needed
+    }
+    rows_host = np.zeros(padded, dtype=bool)
+    rows_host[:n] = True
+    flat[ROW_MASK] = jax.device_put(rows_host, sharding)
+
+    keys, n_sentinel, n_null = jax.jit(build)(flat)
+
+    m_local = padded // ndev
+    # pow2 capacity (shared compiles); 4x the uniform expectation is
+    # comfortable headroom for hashed buckets — dropped rows never
+    # enter the shuffle, so nulls/filters cannot skew a bucket
+    cap = 1 << max(8, ((4 * m_local) // ndev - 1).bit_length())
+    out = _sharded_spill_fn(mesh, axis, cap)(keys, n_sentinel, n_null)
+    scalars, g_keys, g_counts, g_segs, overflow, n_null_global = out
+    scalars, overflow_host, n_null_host, segs_host = packed_device_get(
+        (scalars, overflow, n_null_global, np.asarray(g_segs))
+    )
+    if int(overflow_host) > 0:
+        raise SpillOverflow(
+            f"hash bucket exceeded capacity {cap} on {label}"
+        )
+    return scalars, g_keys, g_counts, segs_host, int(n_null_host)
+
+
+def _sharded_spill_joint_frequencies(
+    dataset: Dataset, plan, engine, dictionaries, sizes, pred
+) -> "ShardedDeviceFrequencies":
+    """Meshed multi-column joint spill (SURVEY §2.6, closing the
+    'meshed multi-column spills use the host path' gap): the joint
+    mixed-radix codes pack into ONE u64 lane (< 2^62 — two-lane joints
+    stay single-device), after which the bucketed all_to_all shuffle,
+    per-shard sort + segment count, and scalar psums are EXACTLY the
+    single-column sharded machinery (_sharded_shuffle) — joint keys
+    can never collide with the sentinel, so the analytic int64.max
+    group reconstruction degenerates to zero."""
+    columns = list(plan.columns)
+    needed = {
+        r
+        for c in columns
+        for r in (ColumnRequest(c, "codes"), ColumnRequest(c, "mask"))
+    }
+    if pred is not None:
+        needed.update(pred.requests)
+
+    key_fn = _joint_chunk_key_fn(len(columns))
+    sizes_dev = jnp.asarray(np.asarray(sizes, dtype=np.int64))
+
+    def build(batch):
+        rows = batch[ROW_MASK]
+        if pred is not None:
+            rows = rows & pred.complies(batch)
+        keys, n_sentinel = key_fn(
+            tuple(batch[f"{c}::codes"] for c in columns),
+            tuple(batch[f"{c}::mask"] for c in columns),
+            rows,
+            sizes_dev,
+        )
+        return keys, n_sentinel, jnp.int64(0)  # no null group (gated)
+
+    scalars, g_keys, g_counts, segs_host, _ = _sharded_shuffle(
+        dataset, engine, needed, build, label=f"joint {columns!r}"
+    )
+    state = ShardedDeviceFrequencies(
+        plan.columns,
+        np.dtype(np.int64),
+        scalars,
+        g_keys,
+        g_counts,
+        0,
+        False,
+        joint=(list(dictionaries), list(sizes)),
+    )
+    state._dev = (g_keys, g_counts, segs_host)
+    return state
 
 
 def device_spill_joint_frequencies(
@@ -925,6 +1052,14 @@ def device_spill_joint_frequencies(
 
         pred = compile_predicate(plan.where, dataset)
         requests += list(pred.requests)
+
+    if engine is not None and getattr(engine, "mesh", None) is not None:
+        if not joint_fits_one_lane(sizes):
+            # two-lane joints have no meshed shuffle variant
+            raise SpillOverflow("two-lane joint has no mesh path")
+        return _sharded_spill_joint_frequencies(
+            dataset, plan, engine, dictionaries, sizes, pred
+        )
 
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
@@ -1153,40 +1288,9 @@ def _sharded_spill_frequencies(
     the dp axis), then run the hash-bucket all_to_all re-shard + local
     sort (see _sharded_spill_fn). Raises SpillOverflow when a bucket
     exceeds its static capacity; the caller falls back to Arrow."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deequ_tpu.engine.pack import packed_device_get
-
-    mesh, axis = engine.mesh, engine.dp_axis
-    ndev = mesh.shape[axis]
-    n = dataset.num_rows
-    # pow2 padding (rounded to a mesh multiple): the per-shard sort's
-    # expensive-to-compile program is then shared across datasets whose
-    # row counts round the same way, exactly like the single-device path
-    pow2 = 1 << max(1, int(max(n, 1) - 1).bit_length())
-    padded = max(1, -(-pow2 // ndev)) * ndev
-    sharding = NamedSharding(mesh, P(axis))
-
-    def pad_to(host: np.ndarray) -> np.ndarray:
-        if len(host) < padded:
-            host = np.concatenate(
-                [host, np.zeros(padded - len(host), dtype=host.dtype)]
-            )
-        return host
-
-    flat = {}
     needed = {ColumnRequest(column, "values"), ColumnRequest(column, "mask")}
     if pred is not None:
         needed.update(pred.requests)
-    for r in needed:
-        flat[r.key] = jax.device_put(
-            pad_to(dataset.materialize(r)), sharding
-        )
-    rows_host = np.zeros(padded, dtype=bool)
-    rows_host[:n] = True
-    flat[ROW_MASK] = jax.device_put(rows_host, sharding)
-
     key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
 
     def build(batch):
@@ -1197,29 +1301,16 @@ def _sharded_spill_frequencies(
             batch[f"{column}::values"], batch[f"{column}::mask"], rows
         )
 
-    keys, n_sentinel, n_null = jax.jit(build)(flat)
-
-    m_local = padded // ndev
-    # pow2 capacity (shared compiles); 4x the uniform expectation is
-    # comfortable headroom for hashed buckets — dropped rows never
-    # enter the shuffle, so nulls/filters cannot skew a bucket
-    cap = 1 << max(8, ((4 * m_local) // ndev - 1).bit_length())
-    out = _sharded_spill_fn(mesh, axis, cap)(keys, n_sentinel, n_null)
-    scalars, g_keys, g_counts, g_segs, overflow, n_null_global = out
-    scalars, overflow_host, n_null_host, segs_host = packed_device_get(
-        (scalars, overflow, n_null_global, np.asarray(g_segs))
+    scalars, g_keys, g_counts, segs_host, n_null_host = _sharded_shuffle(
+        dataset, engine, needed, build, label=repr(column)
     )
-    if int(overflow_host) > 0:
-        raise SpillOverflow(
-            f"hash bucket exceeded capacity {cap} on column {column!r}"
-        )
     state = ShardedDeviceFrequencies(
         plan.columns,
         values_dtype,
         scalars,
         g_keys,
         g_counts,
-        int(n_null_host),
+        n_null_host,
         bool(plan.include_nulls),
     )
     state._dev = (g_keys, g_counts, segs_host)

@@ -419,3 +419,46 @@ class TestR4JointExtensions:
         assert split_joint_lanes((big, big)) == 1  # needs two lanes
         assert split_joint_lanes((big, big, big, big)) is None
         assert split_joint_lanes((2**63,)) is None  # single digit too big
+
+    def test_meshed_joint_spill_equals_host(self, cpu_mesh):
+        """r4: meshed multi-column joint spills ride the hash-bucket
+        all_to_all shuffle (single-u64-lane joints) instead of falling
+        to host Arrow — metrics must equal the Arrow oracle exactly."""
+        from deequ_tpu.analyzers import MutualInformation
+        from deequ_tpu.analyzers.grouping import (
+            FrequencyPlan,
+            compute_many_frequencies,
+        )
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(41)
+        n = 24_000
+        a = rng.integers(0, 3_000, n, dtype=np.int64)
+        b = np.where(rng.random(n) < 0.5, a, rng.integers(0, 3_000, n))
+        ds = Dataset.from_pydict({"a": list(a), "b": list(b)})
+        analyzers = [
+            CountDistinct(["a", "b"]),
+            Uniqueness(["a", "b"]),
+            Entropy(["a", "b"]),
+            MutualInformation(["a", "b"]),
+        ]
+        engine = AnalysisEngine(mesh=cpu_mesh, batch_size=n)
+        with config.configure(dense_grouping_budget_bytes=1024):
+            events = []
+            plan = FrequencyPlan(("a", "b"), None, False)
+            compute_many_frequencies(
+                ds, [plan], engine=engine, events=events
+            )
+            assert any(
+                e.get("path") == "device-sort-joint" for e in events
+            ), events
+            with config.configure(device_spill_grouping=True):
+                ctx_mesh = AnalysisRunner.do_analysis_run(
+                    ds, analyzers, engine=engine
+                )
+            with config.configure(device_spill_grouping=False):
+                ctx_host = AnalysisRunner.do_analysis_run(ds, analyzers)
+        for z in analyzers:
+            d, h = ctx_mesh.metric(z).value, ctx_host.metric(z).value
+            assert d.is_success and h.is_success, (z, d, h)
+            assert d.get() == pytest.approx(h.get(), rel=1e-9), z
