@@ -70,6 +70,10 @@ from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
 
 _SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
 _BIAS = np.uint64(1) << np.uint64(63)
+# test hook: force the host f64-bit packing path on CPU backends
+# (where the device bitcast also works) so the mesh variant is
+# exercisable under the virtual CPU mesh
+_FORCE_HOST_F64_BITS = False
 
 
 @functools.lru_cache(maxsize=None)
@@ -95,6 +99,25 @@ def _joint_chunk_key_fn(n_columns: int):
         return keys.ravel(), n_sentinel
 
     return jax.jit(build)
+
+
+def _finish_keys(keys, mask, rows, include_nulls: bool):
+    """Traced: the ONE copy of the sentinel/null bookkeeping every key
+    builder shares — ``keys`` are the already-canonicalized u64 key
+    bits; non-contributing rows map to the sentinel, null rows are
+    counted when the plan keeps a null group."""
+    if include_nulls:
+        null = rows & ~mask
+        contributes = rows & mask
+    else:
+        null = jnp.zeros_like(rows)
+        contributes = rows & mask
+    keys = jnp.where(contributes, keys, _SENTINEL)
+    return (
+        keys.ravel(),
+        jnp.sum(~contributes, dtype=jnp.int64),
+        jnp.sum(null, dtype=jnp.int64),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -133,16 +156,7 @@ def _chunk_key_fn(key_kind: str, include_nulls: bool):
             )
         else:
             keys = values.astype(jnp.int64).astype(jnp.uint64) ^ _BIAS
-        if include_nulls:
-            null = rows & ~mask
-            contributes = rows & mask
-        else:
-            null = jnp.zeros_like(rows)
-            contributes = rows & mask
-        keys = jnp.where(contributes, keys, _SENTINEL)
-        n_sentinel = jnp.sum(~contributes, dtype=jnp.int64)
-        n_null = jnp.sum(null, dtype=jnp.int64)
-        return keys.ravel(), n_sentinel, n_null
+        return _finish_keys(keys, mask, rows, include_nulls)
 
     return jax.jit(build)
 
@@ -176,16 +190,11 @@ def _joint_chunk_key2_fn(n1: int, n2: int):
     return jax.jit(build)
 
 
-def host_f64_u64_keys(
-    values: np.ndarray, mask: np.ndarray, rows: np.ndarray,
-    include_nulls: bool,
-):
-    """HOST twin of _chunk_key_fn's f64 branch, for backends whose X64
-    rewriter cannot lower the f64->u64 bitcast (TPU; see module
-    docstring): same canonical-NaN bits, same -0.0 remap, same
-    sentinel bookkeeping — the produced u64 keys are bit-identical to
-    the CPU device path's (pinned by tests), so downstream sort/
-    segment/decode is shared untouched."""
+def f64_canonical_bits(values: np.ndarray) -> np.ndarray:
+    """HOST twin of the f64 key canonicalization in _chunk_key_fn, for
+    backends whose X64 rewriter cannot lower the f64->u64 bitcast
+    (TPU; see module docstring): canonical NaN bits, -0.0 remapped to
+    0 — bit-identical to the CPU device path's keys."""
     bits = (
         np.ascontiguousarray(values, dtype=np.float64)
         .view(np.uint64)
@@ -194,6 +203,16 @@ def host_f64_u64_keys(
     x = np.asarray(values, dtype=np.float64)
     bits[np.isnan(x)] = np.uint64(0x7FF8000000000000)
     bits[bits == np.uint64(0x8000000000000000)] = np.uint64(0)
+    return bits
+
+
+def host_f64_u64_keys(
+    values: np.ndarray, mask: np.ndarray, rows: np.ndarray,
+    include_nulls: bool,
+):
+    """f64_canonical_bits plus the sentinel bookkeeping of
+    _chunk_key_fn — the single-device host packing path."""
+    bits = f64_canonical_bits(values)
     if include_nulls:
         null = rows & ~mask
         contributes = rows & mask
@@ -858,20 +877,9 @@ def device_spill_eligible(dataset: Dataset, plan, engine=None) -> bool:
     if dt.kind == "u" and dt.itemsize == 8:
         return False
     # f64 keys: CPU-class backends bitcast on device; elsewhere (TPU)
-    # the u64 keys are packed on the HOST (host_f64_u64_keys — the X64
-    # rewriter cannot lower the f64 bitcast, measured r4) and the same
-    # device sort runs. The MESH kernel has no host-packing variant,
-    # so meshed f64 plans must keep the dense/Arrow planning instead
-    # of spilling into a guaranteed run-time fallback
-    if dt.kind == "f" and np.dtype(dt).itemsize == 8:
-        import jax
-
-        if (
-            jax.default_backend() != "cpu"
-            and engine is not None
-            and getattr(engine, "mesh", None) is not None
-        ):
-            return False
+    # the canonical u64 bits pack on the HOST (f64_canonical_bits —
+    # the X64 rewriter cannot lower the f64 bitcast, measured r4) and
+    # the same device sort runs, single-device and meshed alike
     # headroom gate: the pass pins values+mask chunks in the cache
     # (~9 B/row) AND allocates sort transients outside cache accounting
     # (u64 keys + sorted copy + group keys + counts ~ 30 B/row, pow2
@@ -924,7 +932,9 @@ def joint_fits_one_lane(sizes) -> bool:
     return split_joint_lanes(tuple(sizes)) == len(tuple(sizes))
 
 
-def _sharded_shuffle(dataset, engine, needed, build, label: str):
+def _sharded_shuffle(
+    dataset, engine, needed, build, label: str, extra_arrays=None
+):
     """Shared mesh-spill scaffolding (the ONE copy): pow2/mesh-multiple
     padding (so the per-shard sort's expensive-to-compile program is
     shared across datasets whose row counts round the same way),
@@ -957,6 +967,10 @@ def _sharded_shuffle(dataset, engine, needed, build, label: str):
         r.key: jax.device_put(pad_to(dataset.materialize(r)), sharding)
         for r in needed
     }
+    for key, host in (extra_arrays or {}).items():
+        # caller-prepared arrays (e.g. host-packed f64 key bits) stage
+        # like any column
+        flat[key] = jax.device_put(pad_to(host), sharding)
     rows_host = np.zeros(padded, dtype=bool)
     rows_host[:n] = True
     flat[ROW_MASK] = jax.device_put(rows_host, sharding)
@@ -1181,10 +1195,8 @@ def device_spill_frequencies(
     host_f64 = key_kind == "f64" and _jax.default_backend() != "cpu"
 
     if engine is not None and getattr(engine, "mesh", None) is not None:
-        if host_f64:
-            # the mesh kernel needs the on-device bitcast the TPU X64
-            # rewriter lacks; exactness wins — Arrow fallback
-            raise SpillOverflow("f64 keys need host packing; no mesh path")
+        # f64 on non-CPU meshes rides host-packed bits inside the
+        # sharded build — see _sharded_spill_frequencies
         return _sharded_spill_frequencies(
             dataset, plan, engine, column, values_dtype, key_kind, pred
         )
@@ -1288,21 +1300,52 @@ def _sharded_spill_frequencies(
     the dp axis), then run the hash-bucket all_to_all re-shard + local
     sort (see _sharded_spill_fn). Raises SpillOverflow when a bucket
     exceeds its static capacity; the caller falls back to Arrow."""
+    import jax as _jax
+
     needed = {ColumnRequest(column, "values"), ColumnRequest(column, "mask")}
     if pred is not None:
         needed.update(pred.requests)
-    key_fn = _chunk_key_fn(key_kind, bool(plan.include_nulls))
+    include_nulls = bool(plan.include_nulls)
+    host_bits = key_kind == "f64" and (
+        _jax.default_backend() != "cpu" or _FORCE_HOST_F64_BITS
+    )
+    extra = None
+    if host_bits:
+        # the TPU X64 rewriter can't lower the f64 bitcast, so the
+        # canonical u64 bits pack on the HOST and stage like a column;
+        # the jitted build only applies mask/sentinel bookkeeping
+        if pred is None or ColumnRequest(column, "values") not in set(
+            pred.requests
+        ):  # the predicate may still need the raw values
+            needed.discard(ColumnRequest(column, "values"))
+        extra = {
+            "__f64bits__": f64_canonical_bits(
+                dataset.materialize(ColumnRequest(column, "values"))
+            )
+        }
+    key_fn = (
+        None if host_bits else _chunk_key_fn(key_kind, include_nulls)
+    )
 
     def build(batch):
         rows = batch[ROW_MASK]
         if pred is not None:
             rows = rows & pred.complies(batch)
+        if host_bits:  # bits pre-canonicalized on the host; shared
+            # sentinel/null bookkeeping (_finish_keys, the one copy)
+            return _finish_keys(
+                batch["__f64bits__"],
+                batch[f"{column}::mask"],
+                rows,
+                include_nulls,
+            )
         return key_fn(
             batch[f"{column}::values"], batch[f"{column}::mask"], rows
         )
 
     scalars, g_keys, g_counts, segs_host, n_null_host = _sharded_shuffle(
-        dataset, engine, needed, build, label=repr(column)
+        dataset, engine, needed, build, label=repr(column),
+        extra_arrays=extra,
     )
     state = ShardedDeviceFrequencies(
         plan.columns,

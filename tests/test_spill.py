@@ -462,3 +462,47 @@ class TestR4JointExtensions:
             d, h = ctx_mesh.metric(z).value, ctx_host.metric(z).value
             assert d.is_success and h.is_success, (z, d, h)
             assert d.get() == pytest.approx(h.get(), rel=1e-9), z
+
+    def test_meshed_f64_host_bits_equals_host(self, cpu_mesh, monkeypatch):
+        """r4: meshed f64 grouping via host-packed canonical bits (the
+        TPU path, forced on via the test hook so the CPU mesh can
+        exercise it) must equal the Arrow oracle — incl. NaN payloads
+        and -0.0."""
+        from deequ_tpu.analyzers import spill as spill_mod
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(43)
+        n = 16_000
+        vals = rng.normal(0, 1, n)
+        vals[::7] = np.nan
+        vals[::11] = -0.0
+        vals[::13] = 0.0
+        arr = vals.astype(object)
+        arr[::17] = None
+        ds = Dataset.from_pydict({"f": list(arr)})
+        analyzers = [
+            CountDistinct("f"),
+            Uniqueness("f"),
+            Distinctness("f"),
+            Entropy("f"),
+        ]
+        monkeypatch.setattr(spill_mod, "_FORCE_HOST_F64_BITS", True)
+        engine = AnalysisEngine(mesh=cpu_mesh, batch_size=n)
+        with config.configure(device_spill_grouping=True):
+            ctx_mesh = AnalysisRunner.do_analysis_run(
+                ds, analyzers, engine=engine
+            )
+        # the device path must actually have run (not a vacuous
+        # Arrow-vs-Arrow comparison)
+        events = [
+            e
+            for e in (ctx_mesh.run_metadata.events or [])
+            if e.get("event") == "grouping_spill"
+        ]
+        assert any(e["path"] == "device-sort" for e in events), events
+        with config.configure(device_spill_grouping=False):
+            ctx_host = AnalysisRunner.do_analysis_run(ds, analyzers)
+        for z in analyzers:
+            d, h = ctx_mesh.metric(z).value, ctx_host.metric(z).value
+            assert d.is_success and h.is_success, (z, d, h)
+            assert d.get() == pytest.approx(h.get(), rel=1e-9), z
