@@ -38,6 +38,8 @@ SURVEY.md §2.2 Compliance = "arbitrary SQL predicate"):
 | CONCAT(...) | at most one column operand, literals around it |
 | CAST(x AS INT/BIGINT/DOUBLE/...) | numeric targets; string operands parse per dictionary entry, unparseable -> NULL |
 | ts_col <op> 'YYYY-MM-DD[ HH:MM:SS]' | date literal in the column's unit |
+| DATE_ADD(ts_col, n) / DATE_SUB | shifts by whole days in the column's unit |
+| DATEDIFF(a, b) | UTC-day difference; timestamp columns and/or date literals |
 | literals | numbers, 'strings', TRUE/FALSE/NULL |
 
 String functions never reach the device: they evaluate host-side over
@@ -49,7 +51,8 @@ mid-scan.
 
 Known not-yet-implemented vs full Spark SQL (documented, degrade
 cleanly): string-valued CASE/COALESCE results, multi-column CONCAT,
-CAST to STRING, date arithmetic (date_add/datediff).
+CAST to STRING or of timestamps, timezone-aware date semantics
+(DATEDIFF counts UTC days).
 """
 
 from __future__ import annotations
@@ -422,6 +425,20 @@ def parse_predicate(expression: str) -> Node:
     return _Parser(tokenize(expression)).parse()
 
 
+def _validate_date_literal(text: str) -> None:
+    """The ONE date-literal validation (plan time); comparison and
+    DATEDIFF literals must accept/reject identically."""
+    import datetime as _dt
+
+    try:
+        _dt.datetime.fromisoformat(text)
+    except ValueError as exc:
+        raise PredicateParseError(
+            f"{text!r} is not a date/timestamp literal "
+            "(YYYY-MM-DD[ HH:MM:SS])"
+        ) from exc
+
+
 def _sql_like_to_regex(pattern: str) -> str:
     out = []
     for ch in pattern:
@@ -453,9 +470,15 @@ class _Val:
     # UPPER/LOWER/SUBSTR chains): consumers build per-code LUTs from
     # transform(dict[i]) instead of dict[i]; None = raw values
     transform: Optional[Callable[[str], str]] = None
-    # set for TIMESTAMP/date columns: comparisons against 'YYYY-MM-DD'
-    # string literals convert the literal into this column's epoch unit
+    # timestamp/date lane: ``ts_per_day`` = how many epoch units make
+    # one UTC day (set for TIMESTAMP/date columns and DATE_ADD results;
+    # 1 = day-valued). Comparisons convert string literals into this
+    # unit, and mixed-unit lanes normalize to the finer unit.
+    # ``ts_col`` names the source column when the values are its RAW
+    # storage epochs (literal conversion then goes through the exact
+    # Arrow cast); None for derived day-valued lanes.
     ts_col: Optional[str] = None
+    ts_per_day: Optional[int] = None
 
     def view(self, value: str) -> str:
         return self.transform(value) if self.transform else value
@@ -683,10 +706,44 @@ def _check_types(node: Node, schema) -> str:
             # they would poison every co-scheduled analyzer
             if n.name not in (
                 "ABS", "LENGTH", "COALESCE", "CONCAT",
+                "DATE_ADD", "DATE_SUB", "DATEDIFF",
             ) + _STRING_FNS:
                 raise PredicateParseError(
                     f"unsupported function {n.name} in a predicate"
                 )
+            if n.name in ("DATE_ADD", "DATE_SUB"):
+                if len(n.args) != 2:
+                    raise PredicateParseError(
+                        f"{n.name} takes (timestamp column, days)"
+                    )
+                if kind_of(n.args[0]) != "timestamp":
+                    raise PredicateParseError(
+                        f"{n.name} requires a timestamp/date column"
+                    )
+                _static_int(n.args[1], f"{n.name} day count")
+                return "timestamp"
+            if n.name == "DATEDIFF":
+                if len(n.args) != 2:
+                    raise PredicateParseError(
+                        "DATEDIFF takes (end, start)"
+                    )
+                kinds_ = []
+                for a in n.args:
+                    k = kind_of(a)
+                    if k == "stringlit":
+                        assert isinstance(a, StringLit)
+                        _validate_date_literal(a.value)
+                    elif k != "timestamp":
+                        raise PredicateParseError(
+                            "DATEDIFF arguments must be timestamp "
+                            "columns or date literals"
+                        )
+                    kinds_.append(k)
+                if all(k == "stringlit" for k in kinds_):
+                    raise PredicateParseError(
+                        "DATEDIFF of two literals is constant"
+                    )
+                return "value"
             if n.name == "CONCAT":
                 if not n.args:
                     raise PredicateParseError("CONCAT needs arguments")
@@ -804,13 +861,7 @@ def _check_types(node: Node, schema) -> str:
         for node_, kind_, other in ((a, ak, bk), (b, bk, ak)):
             if kind_ == "stringlit" and other == "timestamp":
                 assert isinstance(node_, StringLit)
-                try:
-                    _dt.datetime.fromisoformat(node_.value)
-                except ValueError as exc:
-                    raise PredicateParseError(
-                        f"{node_.value!r} is not a date/timestamp "
-                        "literal (YYYY-MM-DD[ HH:MM:SS])"
-                    ) from exc
+                _validate_date_literal(node_.value)
 
     def check_cmp(a: Node, b: Node) -> None:
         check_kinds(kind_of(a), kind_of(b), "BETWEEN")
@@ -1031,6 +1082,28 @@ def _eval_string_fn(
     )
 
 
+def _units_per_day(arrow_type) -> int:
+    """How many of the column's int64 epoch units make one UTC day
+    (mirrors the values-repr cast in data.table.convert_basic_repr)."""
+    import pyarrow as pa
+
+    if pa.types.is_date32(arrow_type):
+        return 1
+    if pa.types.is_date64(arrow_type):
+        return 86_400_000
+    unit = getattr(arrow_type, "unit", "us")
+    return 86_400 * {
+        "s": 1, "ms": 1_000, "us": 1_000_000, "ns": 1_000_000_000
+    }[unit]
+
+
+def _epoch_days_of_literal(literal: str) -> int:
+    import datetime as _dt
+
+    d = _dt.datetime.fromisoformat(literal).date()
+    return (d - _dt.date(1970, 1, 1)).days
+
+
 def _date_literal_epoch(ds, column: str, literal: str) -> int:
     """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> the column's int64 epoch
     value (same cast the values repr uses: pc.cast(col, int64) keeps
@@ -1066,11 +1139,15 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         if kind == Kind.STRING:
             return _Val(batch[f"{node.name}::codes"], mask, codes_of=node.name)
         vals = batch[f"{node.name}::values"]
+        is_ts = kind == Kind.TIMESTAMP
         return _Val(
             vals,
             mask,
             is_bool=kind == Kind.BOOLEAN,
-            ts_col=node.name if kind == Kind.TIMESTAMP else None,
+            ts_col=node.name if is_ts else None,
+            ts_per_day=(
+                _units_per_day(ds.arrow_type(node.name)) if is_ts else None
+            ),
         )
     if isinstance(node, NumberLit):
         return _Val(jnp.asarray(node.value), jnp.asarray(True))
@@ -1250,6 +1327,48 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             return _Val(
                 lut[jnp.clip(idx, 0, len(dictionary))], v.valid
             )
+        if node.name in ("DATE_ADD", "DATE_SUB"):
+            v = _eval(node.args[0], batch, ds)
+            if v.ts_per_day is None:
+                raise PredicateParseError(
+                    f"{node.name} requires a timestamp/date column"
+                )
+            n_days = _static_int(node.args[1], f"{node.name} day count")
+            if node.name == "DATE_SUB":
+                n_days = -n_days
+            # Spark's date_add casts to DATE first: the result is
+            # DAY-valued (time-of-day truncates), so equality against
+            # date literals behaves like Spark's
+            days = jnp.floor_divide(
+                v.values.astype(jnp.int64), jnp.int64(v.ts_per_day)
+            )
+            return _Val(
+                days + jnp.int64(n_days), v.valid, ts_per_day=1
+            )
+        if node.name == "DATEDIFF":
+            def days_of(arg):
+                if isinstance(arg, StringLit):
+                    return (
+                        jnp.int64(_epoch_days_of_literal(arg.value)),
+                        jnp.asarray(True),
+                    )
+                v = _eval(arg, batch, ds)
+                if v.ts_per_day is None:
+                    raise PredicateParseError(
+                        "DATEDIFF arguments must be timestamp columns "
+                        "or date literals"
+                    )
+                return (
+                    jnp.floor_divide(
+                        v.values.astype(jnp.int64),
+                        jnp.int64(v.ts_per_day),
+                    ),
+                    v.valid,
+                )
+
+            end_days, end_valid = days_of(node.args[0])
+            start_days, start_valid = days_of(node.args[1])
+            return _Val(end_days - start_days, end_valid & start_valid)
         if node.name == "CONCAT":
             # at most ONE column operand (checked at plan time):
             # literals fold into the transform around it
@@ -1316,11 +1435,18 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 else (node.right, node.left)
             )
             base = _eval(col_node, batch, ds)
-            if base.ts_col is not None:
-                # timestamp vs date literal: the literal converts to
-                # the COLUMN's epoch unit at trace time; the device
-                # compare stays numeric
-                epoch = _date_literal_epoch(ds, base.ts_col, lit.value)
+            if base.ts_per_day is not None:
+                # timestamp/date lane vs date literal: the literal
+                # converts to the lane's epoch unit at trace time (via
+                # the exact Arrow cast for raw columns; as UTC days
+                # for day-valued DATE_ADD results); the device compare
+                # stays numeric
+                if base.ts_col is not None:
+                    epoch = _date_literal_epoch(
+                        ds, base.ts_col, lit.value
+                    )
+                else:
+                    epoch = _epoch_days_of_literal(lit.value)
                 lv, rv = (
                     (base.values, epoch)
                     if lit_on_right
@@ -1354,6 +1480,25 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         rhs = _eval(node.right, batch, ds)
         valid = lhs.valid & rhs.valid
         lv, rv = lhs.values, rhs.values
+        if (
+            node.op in _CMP
+            and lhs.ts_per_day is not None
+            and rhs.ts_per_day is not None
+            and lhs.ts_per_day != rhs.ts_per_day
+        ):
+            # mixed-unit timestamp lanes (timestamp[us] vs date32, or
+            # a day-valued DATE_ADD vs a raw column): scale the coarser
+            # side up to the finer unit so epochs compare as instants
+            # (comparing raw epochs across units would be silently
+            # wrong — r4 review finding)
+            if lhs.ts_per_day < rhs.ts_per_day:
+                lv = lv.astype(jnp.int64) * jnp.int64(
+                    rhs.ts_per_day // lhs.ts_per_day
+                )
+            else:
+                rv = rv.astype(jnp.int64) * jnp.int64(
+                    lhs.ts_per_day // rhs.ts_per_day
+                )
         if node.op in _CMP:
             if lhs.codes_of is not None and rhs.codes_of is not None:
                 # two string columns: dictionary codes come from

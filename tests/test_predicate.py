@@ -342,3 +342,92 @@ class TestR4GrammarExtensions:
         ctx = AnalysisRunner.do_analysis_run(ts, [bad, good])
         assert ctx.metric(bad).value.is_failure
         assert ctx.metric(good).value.is_success
+
+    def test_date_arithmetic(self):
+        import datetime
+
+        ts = [
+            datetime.datetime(2024, 1, 1, 23, 0),
+            datetime.datetime(2024, 1, 10),
+            datetime.datetime(2024, 2, 1),
+            None,
+        ]
+        ds = Dataset.from_arrow(
+            pa.table(
+                {
+                    "t": pa.array(ts, pa.timestamp("us")),
+                    "d": pa.array(
+                        [v.date() if v else None for v in ts], pa.date32()
+                    ),
+                }
+            )
+        )
+        # DATE_ADD shifts by whole days in the column's unit
+        assert compliance(ds, "DATE_ADD(t, 5) >= '2024-01-07'") == 0.5
+        assert compliance(ds, "DATE_SUB(t, 9) < '2024-01-02'") == 0.5
+        assert compliance(ds, "DATE_ADD(d, 31) >= '2024-02-01'") == 0.75
+        # DATEDIFF: column vs literal, both directions, two columns
+        assert compliance(ds, "DATEDIFF(t, '2024-01-01') = 9") == 0.25
+        assert compliance(ds, "DATEDIFF('2024-02-01', t) = 31") == 0.25
+        assert compliance(ds, "DATEDIFF(t, d) = 0") == 0.75  # same day
+        # null rows are never compliant
+        assert compliance(ds, "DATEDIFF(t, '2000-01-01') > 0") == 0.75
+
+    def test_date_arithmetic_plan_time_failures(self):
+        import datetime
+
+        from deequ_tpu.analyzers import AnalysisRunner
+
+        ds = Dataset.from_arrow(
+            pa.table(
+                {
+                    "t": pa.array(
+                        [datetime.datetime(2024, 1, 1)], pa.timestamp("us")
+                    ),
+                    "x": pa.array([1.0]),
+                }
+            )
+        )
+        bads = [
+            Compliance("b1", "DATE_ADD(x, 1) > 0"),  # not a timestamp
+            Compliance("b2", "DATE_ADD(t, x) > '2024-01-01'"),  # non-static
+            Compliance("b3", "DATEDIFF('2024-01-01', '2024-01-02') = 1"),
+            Compliance("b4", "DATEDIFF(t, 'nope') = 1"),  # bad literal
+        ]
+        good = Mean("x")
+        ctx = AnalysisRunner.do_analysis_run(ds, bads + [good])
+        assert ctx.metric(good).value.is_success
+        for bad in bads:
+            assert ctx.metric(bad).value.is_failure, bad
+
+    def test_date_add_truncates_and_mixed_units_compare(self):
+        """r4 review: DATE_ADD casts to DATE first (Spark), and
+        timestamp[us] vs date32 comparisons normalize units instead of
+        comparing raw epochs."""
+        import datetime
+
+        ts = [
+            datetime.datetime(2024, 1, 1, 23, 0),
+            datetime.datetime(2024, 1, 10, 5, 30),
+            None,
+        ]
+        ds = Dataset.from_arrow(
+            pa.table(
+                {
+                    "t": pa.array(ts, pa.timestamp("us")),
+                    "d": pa.array(
+                        [v.date() if v else None for v in ts], pa.date32()
+                    ),
+                }
+            )
+        )
+        # Spark: date_add('2024-01-01 23:00', 6) = DATE '2024-01-07'
+        assert compliance(ds, "DATE_ADD(t, 6) = '2024-01-07'") == pytest.approx(1 / 3)
+        # timestamp vs date32 column: same calendar instant at midnight
+        # only when the time-of-day is zero; d promotes to t's unit, so
+        # t >= d holds for all real rows and t = d for none (both have
+        # time parts)
+        assert compliance(ds, "t >= d") == pytest.approx(2 / 3)
+        assert compliance(ds, "t = d") == 0.0
+        # day-valued DATE_ADD vs raw column (mixed per-day lanes)
+        assert compliance(ds, "DATE_ADD(d, 1) > t") == pytest.approx(2 / 3)
