@@ -105,6 +105,18 @@ def fixtures() -> Dict[str, pa.Table]:
                 "s": pa.array([None, None, None], pa.string()),
             }
         ),
+        # degenerate second-moment shapes: constant column (zero
+        # variance), zero-sum denominator, correlated/identical pairs
+        "moments_edge": _t(
+            {
+                "const": pa.array([7.0, 7.0, 7.0, 7.0], pa.float64()),
+                "lin": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+                "zsum": pa.array([-2.0, -1.0, 1.0, 2.0], pa.float64()),
+                "g1": pa.array(["a", "a", "b", "b"]),
+                "g2": pa.array(["x", "x", "y", "y"]),
+                "g3": pa.array(["p", "q", "p", "q"]),
+            }
+        ),
         # COUNT(col) vs COUNT(*): where-filtered Size counts kept ROWS
         # (null x included); Completeness counts non-null OF kept rows
         "count_col_vs_star": _t(
@@ -135,6 +147,8 @@ def build_analyzer(spec: Dict[str, Any]):
         Completeness,
         Compliance,
         Correlation,
+        MutualInformation,
+        RatioOfSums,
         CountDistinct,
         DataType,
         Distinctness,
@@ -178,6 +192,8 @@ def build_analyzer(spec: Dict[str, Any]):
             s["column"], s["pattern"]
         ),
         "Correlation": lambda s: Correlation(s["first"], s["second"]),
+        "RatioOfSums": lambda s: RatioOfSums(s["first"], s["second"]),
+        "MutualInformation": lambda s: MutualInformation(s["columns"]),
         "ApproxCountDistinct": lambda s: ApproxCountDistinct(
             s["column"]
         ),
@@ -240,6 +256,30 @@ def cases():
         add("neg_zero", type=t, column="x")
     add("neg_zero", type="CountDistinct", columns=["x"])
     add("neg_zero", type="Distinctness", columns=["x"])
+    # second-moment degenerate shapes: constant column (zero variance
+    # -> correlation undefined), zero-sum denominator, exact linear
+    # dependence, and MI of identical / independent pairs
+    add(
+        "moments_edge", type="Correlation", first="const", second="lin"
+    )
+    add("moments_edge", type="Correlation", first="lin", second="lin")
+    add("moments_edge", type="StandardDeviation", column="const")
+    add(
+        "moments_edge", type="RatioOfSums", first="lin", second="zsum"
+    )
+    add(
+        "moments_edge", type="RatioOfSums", first="zsum", second="lin"
+    )
+    add(
+        "moments_edge",
+        type="MutualInformation",
+        columns=["g1", "g2"],  # identical partitions: MI = H = ln 2
+    )
+    add(
+        "moments_edge",
+        type="MutualInformation",
+        columns=["g1", "g3"],  # independent partitions: MI = 0
+    )
     add("neg_zero_dict", type="CountDistinct", columns=["x"])
     add("neg_zero_dict", type="Distinctness", columns=["x"])
     add("neg_zero_dict", type="Minimum", column="x")
