@@ -719,18 +719,19 @@ class Correlation(ScanShareableAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException("Empty state for analyzer Correlation.")
             )
-        denom = float(np.sqrt(float(state.x_mk)) * np.sqrt(float(state.y_mk)))
-        if denom == 0.0:
-            return self.to_failure_metric(
-                IllegalAnalyzerParameterException(
-                    "Correlation is undefined for zero-variance columns."
-                )
+        # sqrt of the PRODUCT, like Spark's Corr (sqrt(x)*sqrt(y) is
+        # not float-equivalent: exact linear dependence must yield
+        # exactly 1.0); zero variance gives 0/0 = NaN as a SUCCESSFUL
+        # metric value, matching Spark/deequ (r4 review + goldens)
+        denom = float(np.sqrt(float(state.x_mk) * float(state.y_mk)))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            value = (
+                float(np.float64(state.ck) / denom)
+                if denom != 0.0
+                else float("nan")
             )
         return DoubleMetric.success(
-            self.entity,
-            "Correlation",
-            self.instance,
-            float(state.ck) / denom,
+            self.entity, "Correlation", self.instance, value
         )
 
 

@@ -257,8 +257,10 @@ def cases():
     add("neg_zero", type="CountDistinct", columns=["x"])
     add("neg_zero", type="Distinctness", columns=["x"])
     # second-moment degenerate shapes: constant column (zero variance
-    # -> correlation undefined), zero-sum denominator, exact linear
-    # dependence, and MI of identical / independent pairs
+    # -> Spark's corr yields NaN as a SUCCESSFUL value), zero-sum
+    # denominator, exact linear dependence (exactly 1.0 — sqrt of the
+    # product, not product of sqrts), and MI of identical /
+    # independent pairs
     add(
         "moments_edge", type="Correlation", first="const", second="lin"
     )
