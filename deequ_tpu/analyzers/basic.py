@@ -722,8 +722,22 @@ class Correlation(ScanShareableAnalyzer):
         # sqrt of the PRODUCT, like Spark's Corr (sqrt(x)*sqrt(y) is
         # not float-equivalent: exact linear dependence must yield
         # exactly 1.0); zero variance gives 0/0 = NaN as a SUCCESSFUL
-        # metric value, matching Spark/deequ (r4 review + goldens)
-        denom = float(np.sqrt(float(state.x_mk) * float(state.y_mk)))
+        # metric value, matching Spark/deequ (r4 review + goldens).
+        # The product form overflows to inf when both m_k exceed
+        # ~1e154 and underflows to 0 when both sit below ~1e-162 —
+        # fall back to sqrt(x)*sqrt(y) in either regime (finite
+        # nonzero inputs, finite nonzero answer), keeping the product
+        # form for the exact linear-dependence == 1.0 case
+        # (r4 advisory + review finding).
+        x_mk, y_mk = float(state.x_mk), float(state.y_mk)
+        product = x_mk * y_mk
+        degenerate = (not np.isfinite(product)) or (
+            product == 0.0 and x_mk != 0.0 and y_mk != 0.0
+        )
+        if degenerate and np.isfinite(x_mk) and np.isfinite(y_mk):
+            denom = float(np.sqrt(x_mk) * np.sqrt(y_mk))
+        else:
+            denom = float(np.sqrt(product))
         with np.errstate(invalid="ignore", divide="ignore"):
             value = (
                 float(np.float64(state.ck) / denom)

@@ -73,12 +73,10 @@ def counts_from_code_presence(
     rows match no dictionary slot). The single-analyzer and stacked
     group paths BOTH call this — their states max-merge, so the math
     must stay single-sourced."""
+    from deequ_tpu.sketches.hll import tiled_code_presence
+
     D = table.shape[1]
-    d = jnp.arange(D, dtype=jnp.int32)
-    cnt = (
-        (codes.astype(jnp.int32)[:, None, :] == d[None, :, None])
-        & valid[:, None, :]
-    ).sum(axis=2, dtype=jnp.int32)  # (C, D)
+    cnt = tiled_code_presence(codes, valid, D, count=True)  # (C, D)
     onehot = jax.nn.one_hot(table, 6, dtype=jnp.int32)
     counts = jnp.einsum("cd,cdk->ck", cnt, onehot)
     kept = rows.sum(dtype=jnp.int32)

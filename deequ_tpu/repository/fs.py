@@ -46,6 +46,14 @@ class FileSystemMetricsRepository(MetricsRepository):
         else:
             parent = os.path.dirname(os.path.abspath(path)) or "."
             self._key = os.path.basename(path)
+            if not self._key:
+                # a trailing separator ('dir/') leaves an empty blob
+                # name — refuse like the URI branch does rather than
+                # silently reading/writing the directory root
+                raise ValueError(
+                    "a repository path must name a file, not a "
+                    f"directory: got {path!r}"
+                )
             self._storage = storage_for(parent)
 
     def _read_all(self) -> List[AnalysisResult]:

@@ -156,6 +156,11 @@ def registers_from_hash_pair_stacked(
 # (4, 2^21) block; crossover extrapolates to D ~ 16k. docs/PERF.md.)
 PRESENCE_DICT_CAP = 4096
 
+# D-axis tile for the presence compare-reduce (bounds the (C, TILE, B)
+# intermediate if a backend fails to fuse it; see
+# registers_from_code_presence)
+_PRESENCE_D_TILE = 256
+
 
 def registers_from_code_presence(
     codes: jnp.ndarray,  # (C, B) int codes, -1 = null
@@ -172,13 +177,37 @@ def registers_from_code_presence(
     the VPU eats at full rate, vs one serialized scatter element per
     ROW (~145M elem/s measured) on the per-row path. Null codes (-1)
     match no dictionary slot and vanish."""
-    D = lut1.shape[1]
-    d = jnp.arange(D, dtype=jnp.int32)
-    present = (
-        (codes.astype(jnp.int32)[:, None, :] == d[None, :, None])
-        & mask[:, None, :]
-    ).any(axis=2)
+    present = tiled_code_presence(codes, mask, lut1.shape[1], count=False)
     return registers_from_hash_pair_stacked(lut1, lut2, present)
+
+
+def tiled_code_presence(
+    codes: jnp.ndarray,  # (C, B) int codes, -1 = null
+    mask: jnp.ndarray,  # (C, B) validity
+    D: int,
+    count: bool,
+) -> jnp.ndarray:
+    """(C, D) per-dictionary-slot presence (``count=False``, bool) or
+    occurrence counts (``count=True``, i32) via the compare-reduce.
+
+    The D axis is chunked so the (C, TILE, B) intermediate stays
+    bounded even on a backend where XLA does NOT fuse the compare into
+    the reduce (at the D=4096 cap with B=2^21 an unfused full-D
+    intermediate would be tens of GB — r4 advisory). TILE=256 keeps
+    the worst case ~2 GB/column-block and measured the same as the
+    unchunked form (the reduce dominates either way). Shared by the
+    HLL presence path here and DataType's count path
+    (analyzers/datatype.py) so the tiling can never diverge."""
+    codes_i32 = codes.astype(jnp.int32)
+    tile = min(D, _PRESENCE_D_TILE)
+    parts = []
+    for d0 in range(0, D, tile):
+        d = jnp.arange(d0, min(d0 + tile, D), dtype=jnp.int32)
+        hits = (codes_i32[:, None, :] == d[None, :, None]) & mask[:, None, :]
+        parts.append(
+            hits.sum(axis=2, dtype=jnp.int32) if count else hits.any(axis=2)
+        )
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
 
 
 _Q = 32  # h2 supplies 32 bits => register ranks 0..Q+1

@@ -1186,9 +1186,13 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         integral = node.type_name in _INT_CASTS
         if v.codes_of is not None:
             # string column: parse each dictionary entry ONCE
-            # (Spark cast semantics: unparseable -> NULL)
+            # (Spark cast semantics: unparseable -> NULL). Validity
+            # lives in its OWN table — overloading NaN as the invalid
+            # sentinel would misreport an entry 'NaN' (which Spark
+            # casts to the VALUE NaN) as NULL (r4 advisory).
             dictionary = ds.dictionary(v.codes_of)
-            table = np.full(len(dictionary) + 1, np.nan)
+            table = np.zeros(len(dictionary) + 1)
+            ok = np.zeros(len(dictionary) + 1, dtype=bool)
             for i, s in enumerate(dictionary):
                 if s is not None:
                     text = v.view(str(s)).strip()
@@ -1196,18 +1200,30 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                         continue  # ('1_0'); Spark casts it to NULL
                     try:
                         table[i] = float(text)
+                        ok[i] = True
                     except ValueError:
                         pass
             lut = jnp.asarray(table)
-            idx = jnp.where(v.values < 0, len(dictionary), v.values)
-            vals = lut[jnp.clip(idx, 0, len(dictionary))]
-            valid = v.valid & ~jnp.isnan(vals)
+            ok_lut = jnp.asarray(ok)
+            idx = jnp.clip(
+                jnp.where(v.values < 0, len(dictionary), v.values),
+                0,
+                len(dictionary),
+            )
+            vals = lut[idx]
+            valid = v.valid & ok_lut[idx]
             vals = jnp.where(valid, vals, 0.0)
         else:
             vals = v.values.astype(jnp.float64)
             valid = v.valid
         if integral:
-            vals = jnp.trunc(vals)  # toward zero; NaN values propagate
+            # toward zero; non-finite values have no integral form ->
+            # NULL (keeps cast('NaN' AS INT) NULL while cast('NaN' AS
+            # DOUBLE) stays the value NaN — review finding on the r4
+            # validity-table fix)
+            finite = jnp.isfinite(vals)
+            valid = valid & finite
+            vals = jnp.trunc(jnp.where(finite, vals, 0.0))
         return _Val(vals, valid)
     if isinstance(node, CaseWhen):
         # SQL: first branch whose condition is TRUE wins (NULL

@@ -129,6 +129,41 @@ class TestNumeric:
         metric = RatioOfSums("att1", "att2").calculate(df_numeric())
         assert value(metric) == pytest.approx(21.0 / 18.0)
 
+    def test_correlation_extreme_magnitude_denominator(self):
+        """Both second moments > ~1e154: the product form overflows to
+        inf; the fallback sqrt(x)*sqrt(y) must recover the finite
+        answer instead of silently returning 0.0 (r4 advisory)."""
+        import numpy as np
+
+        from deequ_tpu.analyzers.states import CorrelationState
+
+        mk = np.float64(5e154)  # mk * mk -> inf in f64
+        state = CorrelationState(
+            np.float64(4.0),
+            np.float64(2.5e77),
+            np.float64(2.5e77),
+            np.float64(-5e154),  # perfectly anticorrelated
+            mk,
+            mk,
+        )
+        metric = Correlation("a", "b").compute_metric_from_state(state)
+        assert metric.value.is_success, metric.value
+        assert metric.value.get() == pytest.approx(-1.0)
+        # symmetric regime: both m_k nonzero but the product
+        # UNDERFLOWS to 0 — same fallback must fire (review finding)
+        tiny = np.float64(1e-200)
+        state = CorrelationState(
+            np.float64(4.0),
+            np.float64(1e-100),
+            np.float64(1e-100),
+            tiny,  # perfectly correlated
+            tiny,
+            tiny,
+        )
+        metric = Correlation("a", "b").compute_metric_from_state(state)
+        assert metric.value.is_success, metric.value
+        assert metric.value.get() == pytest.approx(1.0)
+
 
 class TestCompliance:
     def test_predicate(self):

@@ -303,6 +303,32 @@ class TestR4GrammarExtensions:
             ds, "CAST(s AS DOUBLE) IS NULL"
         ) == pytest.approx(2 / 5)  # 'x' and the real null
 
+    def test_cast_nan_entry_is_value_not_null(self):
+        """Spark's cast('NaN' AS DOUBLE) yields the VALUE NaN, not
+        NULL — validity must not be inferred from the parsed value
+        being NaN (r4 advisory)."""
+        ds = Dataset.from_pydict({"s": ["NaN", "1.0", "x", None]})
+        # NaN is NOT NULL (only 'x' and the real null are)
+        assert compliance(
+            ds, "CAST(s AS DOUBLE) IS NULL"
+        ) == pytest.approx(2 / 4)
+        assert compliance(
+            ds, "CAST(s AS DOUBLE) IS NOT NULL"
+        ) == pytest.approx(2 / 4)
+        # NaN compares FALSE (not NULL) against anything
+        assert compliance(
+            ds, "CAST(s AS DOUBLE) >= 0 OR CAST(s AS DOUBLE) < 0"
+        ) == pytest.approx(1 / 4)
+        # ... but non-finite values have no integral form: the INT
+        # cast nulls them (review finding on the validity-table fix)
+        ds2 = Dataset.from_pydict({"s": ["NaN", "Infinity", "1", None]})
+        assert compliance(
+            ds2, "CAST(s AS INT) IS NULL"
+        ) == pytest.approx(3 / 4)
+        assert compliance(
+            ds2, "CAST(s AS DOUBLE) IS NULL"
+        ) == pytest.approx(1 / 4)
+
     def test_concat_cast_plan_time_failures(self, strings_ds):
         from deequ_tpu.analyzers import AnalysisRunner
 

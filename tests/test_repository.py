@@ -133,6 +133,12 @@ class TestInMemoryRepository:
 
 
 class TestFileSystemRepository:
+    def test_directory_path_rejected(self, tmp_path):
+        # a trailing separator leaves an empty blob name; must fail
+        # fast like the URI branch (r4 advisory)
+        with pytest.raises(ValueError):
+            FileSystemMetricsRepository(str(tmp_path) + os.sep)
+
     def test_round_trip(self, context, tmp_path):
         path = os.path.join(tmp_path, "metrics.json")
         repo = FileSystemMetricsRepository(path)

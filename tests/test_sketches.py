@@ -326,3 +326,64 @@ class TestKLLAdversarial:
         self._max_rank_error(
             rng.integers(0, 5, self.N).astype(np.float64)
         )
+
+
+class TestPresenceDTiling:
+    """The presence compare-reduce chunks its D axis (r4 advisory —
+    bounds the (C, TILE, B) intermediate). Multi-tile results must be
+    bit-identical to the single-tile math."""
+
+    def test_hll_presence_multi_tile_matches_unchunked(self):
+        from deequ_tpu.sketches.hll import (
+            _PRESENCE_D_TILE,
+            registers_from_code_presence,
+            registers_from_hash_pair_stacked,
+        )
+
+        rng = np.random.default_rng(11)
+        C, B, D = 3, 1024, _PRESENCE_D_TILE * 2 + 64  # 3 tiles, ragged
+        codes = rng.integers(-1, D, (C, B)).astype(np.int32)
+        mask = codes >= 0
+        lut1 = rng.integers(0, 2**32, (C, D), dtype=np.uint64).astype(
+            np.uint32
+        )
+        lut2 = rng.integers(0, 2**32, (C, D), dtype=np.uint64).astype(
+            np.uint32
+        )
+        got = np.asarray(
+            registers_from_code_presence(codes, mask, lut1, lut2)
+        )
+        # oracle: presence computed densely on host
+        present = np.zeros((C, D), dtype=bool)
+        for c in range(C):
+            occurring = np.unique(codes[c][mask[c]])
+            present[c, occurring] = True
+        want = np.asarray(
+            registers_from_hash_pair_stacked(lut1, lut2, present)
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_datatype_presence_multi_tile_matches_host(self):
+        from deequ_tpu.analyzers.datatype import (
+            DataTypeHistogram,
+            counts_from_code_presence,
+        )
+        from deequ_tpu.sketches.hll import _PRESENCE_D_TILE
+
+        rng = np.random.default_rng(12)
+        C, B, D = 2, 2048, _PRESENCE_D_TILE + 33  # 2 tiles, ragged
+        codes = rng.integers(-1, D, (C, B)).astype(np.int32)
+        valid = codes >= 0
+        rows = np.ones(B, dtype=bool)
+        table = rng.integers(0, 6, (C, D)).astype(np.int32)
+        got = np.asarray(
+            counts_from_code_presence(codes, valid, rows, table)
+        )
+        want = np.zeros((C, 6), dtype=np.int64)
+        for c in range(C):
+            for b in range(B):
+                if valid[c, b]:
+                    want[c, table[c, codes[c, b]]] += 1
+                else:
+                    want[c, DataTypeHistogram.NULL] += 1
+        np.testing.assert_array_equal(got, want)
