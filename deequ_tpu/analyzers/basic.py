@@ -731,8 +731,12 @@ class Correlation(ScanShareableAnalyzer):
         # (r4 advisory + review finding).
         x_mk, y_mk = float(state.x_mk), float(state.y_mk)
         product = x_mk * y_mk
+        # < tiny (not just == 0): a subnormal product carries too few
+        # bits and can report |r| > 1 (review finding)
         degenerate = (not np.isfinite(product)) or (
-            product == 0.0 and x_mk != 0.0 and y_mk != 0.0
+            product < float(np.finfo(np.float64).tiny)
+            and x_mk != 0.0
+            and y_mk != 0.0
         )
         if degenerate and np.isfinite(x_mk) and np.isfinite(y_mk):
             denom = float(np.sqrt(x_mk) * np.sqrt(y_mk))

@@ -1004,6 +1004,16 @@ _CAST_TYPES = (
     "FLOAT", "DOUBLE", "REAL",
 )
 _INT_CASTS = ("INT", "INTEGER", "BIGINT", "LONG", "SMALLINT", "TINYINT")
+# JVM d2i-style saturation bounds per integral target (f64 lane: the
+# i64 bounds round to the nearest representable double)
+_INT_CAST_BOUNDS = {
+    "INT": (-2147483648.0, 2147483647.0),
+    "INTEGER": (-2147483648.0, 2147483647.0),
+    "BIGINT": (-9.223372036854776e18, 9.223372036854776e18),
+    "LONG": (-9.223372036854776e18, 9.223372036854776e18),
+    "SMALLINT": (-32768.0, 32767.0),
+    "TINYINT": (-128.0, 127.0),
+}
 
 
 def _static_int(node: Node, what: str) -> int:
@@ -1213,17 +1223,24 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             vals = lut[idx]
             valid = v.valid & ok_lut[idx]
             vals = jnp.where(valid, vals, 0.0)
-        else:
-            vals = v.values.astype(jnp.float64)
-            valid = v.valid
+            if integral:
+                # a string with no finite numeric value has no
+                # integral parse -> NULL (Spark's string-to-int cast
+                # rejects 'NaN'/'Infinity'; review finding on the r4
+                # validity-table fix)
+                finite = jnp.isfinite(vals)
+                valid = valid & finite
+                vals = jnp.trunc(jnp.where(finite, vals, 0.0))
+            return _Val(vals, valid)
+        vals = v.values.astype(jnp.float64)
+        valid = v.valid
         if integral:
-            # toward zero; non-finite values have no integral form ->
-            # NULL (keeps cast('NaN' AS INT) NULL while cast('NaN' AS
-            # DOUBLE) stays the value NaN — review finding on the r4
-            # validity-table fix)
-            finite = jnp.isfinite(vals)
-            valid = valid & finite
-            vals = jnp.trunc(jnp.where(finite, vals, 0.0))
+            # numeric source follows JVM double-to-int conversion like
+            # non-ANSI Spark: truncate toward zero, SATURATE at the
+            # target bounds, NaN -> 0 (NOT NULL — review finding)
+            lo, hi = _INT_CAST_BOUNDS[node.type_name]
+            vals = jnp.clip(jnp.trunc(vals), lo, hi)
+            vals = jnp.where(jnp.isnan(vals), 0.0, vals)
         return _Val(vals, valid)
     if isinstance(node, CaseWhen):
         # SQL: first branch whose condition is TRUE wins (NULL

@@ -163,6 +163,22 @@ class TestNumeric:
         metric = Correlation("a", "b").compute_metric_from_state(state)
         assert metric.value.is_success, metric.value
         assert metric.value.get() == pytest.approx(1.0)
+        # SUBNORMAL product (nonzero but < tiny): the product form
+        # carries too few bits and can report |r| > 1 — the fallback
+        # must fire there too (review finding)
+        sub = np.float64(1e-160)
+        state = CorrelationState(
+            np.float64(4.0),
+            np.float64(1e-80),
+            np.float64(1e-80),
+            sub,
+            sub,
+            sub,
+        )
+        metric = Correlation("a", "b").compute_metric_from_state(state)
+        assert metric.value.is_success, metric.value
+        assert metric.value.get() == pytest.approx(1.0)
+        assert metric.value.get() <= 1.0
 
 
 class TestCompliance:

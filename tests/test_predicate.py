@@ -319,8 +319,8 @@ class TestR4GrammarExtensions:
         assert compliance(
             ds, "CAST(s AS DOUBLE) >= 0 OR CAST(s AS DOUBLE) < 0"
         ) == pytest.approx(1 / 4)
-        # ... but non-finite values have no integral form: the INT
-        # cast nulls them (review finding on the validity-table fix)
+        # ... but a non-finite STRING has no integral parse: the INT
+        # cast nulls it (review finding on the validity-table fix)
         ds2 = Dataset.from_pydict({"s": ["NaN", "Infinity", "1", None]})
         assert compliance(
             ds2, "CAST(s AS INT) IS NULL"
@@ -328,6 +328,23 @@ class TestR4GrammarExtensions:
         assert compliance(
             ds2, "CAST(s AS DOUBLE) IS NULL"
         ) == pytest.approx(1 / 4)
+
+    def test_cast_numeric_source_jvm_saturation(self):
+        """Numeric-source integral casts follow JVM d2i like non-ANSI
+        Spark: truncate, saturate at the target bounds, NaN -> 0 —
+        never NULL (review finding)."""
+        ds = Dataset.from_pydict(
+            {"x": [float("nan"), float("inf"), -float("inf"), 3e9, 1.5]}
+        )
+        assert compliance(ds, "CAST(x AS INT) IS NOT NULL") == 1.0
+        assert compliance(ds, "CAST(x AS INT) = 0") == 0.2  # NaN
+        assert compliance(
+            ds, "CAST(x AS INT) = 2147483647"
+        ) == 0.4  # +inf and 3e9 both saturate
+        assert compliance(
+            ds, "CAST(x AS SMALLINT) = 32767"
+        ) == 0.4
+        assert compliance(ds, "CAST(x AS BIGINT) > 9000000000") == 0.2
 
     def test_concat_cast_plan_time_failures(self, strings_ds):
         from deequ_tpu.analyzers import AnalysisRunner
