@@ -69,7 +69,7 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             )
             consts = {"h1": pad_pow2(lut1_host), "h2": pad_pow2(lut2_host)}
 
-            def registers_of(batch, c, mask):
+            def registers_of(batch, c, mask, prev):
                 lut1, lut2 = c["h1"], c["h2"]
                 if lut1.shape[0] <= hll.PRESENCE_DICT_CAP:
                     # small dictionary: presence compare-reduce beats
@@ -90,13 +90,20 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
         else:
             consts = None
 
-            def registers_of(batch, c, mask):
-                h1, h2 = hll.hash_pair_numeric(batch[f"{col}::values"])
-                return hll.registers_from_hash_pair(h1, h2, mask)
+            def registers_of(batch, c, mask, prev):
+                # adaptive C=1 group: sorted-dedup when the carried
+                # registers say mid-cardinality (sketches/hll.py)
+                return hll.numeric_registers_adaptive(
+                    batch[f"{col}::values"][None, :],
+                    mask[None, :],
+                    prev[None, :],
+                )[0]
 
         def update(state: ApproxCountDistinctState, batch, consts_in=None):
             mask = batch[f"{col}::mask"] & _row_mask(batch, where_fn)
-            regs = registers_of(batch, consts_in, mask)
+            regs = registers_of(
+                batch, consts_in, mask, state.registers
+            )
             return ApproxCountDistinctState(
                 jnp.maximum(state.registers, regs)
             )

@@ -383,8 +383,11 @@ def _build_hll_group(
                 )
         else:
             x = jnp.stack([batch[f"{c}::values"] for c in columns])
-            h1, h2 = hll.hash_pair_numeric(x)
-            regs = hll.registers_from_hash_pair_stacked(h1, h2, masks)
+            # adaptive: sorted-dedup for mid-cardinality groups (gated
+            # by the carried registers), full scatter otherwise
+            regs = hll.numeric_registers_adaptive(
+                x, masks, state.registers
+            )
         return S.ApproxCountDistinctState(
             jnp.maximum(state.registers, regs)
         )

@@ -1,6 +1,7 @@
 """Sketch accuracy + merge + serde tests (reference shape:
 KLLDistanceTest / KLLSketchTest / HLL accuracy tests — SURVEY.md §4)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -387,3 +388,132 @@ class TestPresenceDTiling:
                 else:
                     want[c, DataTypeHistogram.NULL] += 1
         np.testing.assert_array_equal(got, want)
+
+
+class TestSortedDedupRegisters:
+    """r5 adaptive numeric-HLL path: the sorted-dedup branch must
+    produce BIT-IDENTICAL registers to the per-row scatter (states
+    from the two paths max-merge, so divergence corrupts merges).
+    Covers the sentinel discipline: nulls, real +inf (the float
+    sentinel value), real iinfo.max (the int sentinel value),
+    canonical NaN, -0.0/+0.0, exactly-at-cap and over-cap fallback."""
+
+    def _scatter_ref(self, x, masks):
+        from deequ_tpu.sketches import hll
+
+        h1, h2 = hll.hash_pair_numeric(jnp.asarray(x))
+        return np.asarray(
+            hll.registers_from_hash_pair_stacked(
+                h1, h2, jnp.asarray(masks)
+            )
+        )
+
+    def _dedup(self, x, masks):
+        from deequ_tpu.sketches import hll
+
+        return np.asarray(
+            hll.registers_from_sorted_dedup_stacked(
+                jnp.asarray(x), jnp.asarray(masks)
+            )
+        )
+
+    def test_float_edges_match_scatter(self):
+        rng = np.random.default_rng(31)
+        B = 4096
+        rows = [
+            # mid-card with nulls
+            np.round(rng.normal(100, 25, B) * 100).astype(np.float32)
+            / 100,
+            # real +inf / -inf / NaN / -0.0 / +0.0 mixture
+            np.array(
+                [np.inf, -np.inf, np.nan, -0.0, 0.0, 1.5] * (B // 6)
+                + [1.5] * (B % 6),
+                dtype=np.float32,
+            ),
+            # constant column
+            np.full(B, 42.0, dtype=np.float32),
+        ]
+        x = np.stack(rows)
+        masks = rng.random((3, B)) > 0.1
+        got = self._dedup(x, masks)
+        want = self._scatter_ref(x, masks)
+        np.testing.assert_array_equal(got, want)
+
+    def test_int_edges_match_scatter(self):
+        rng = np.random.default_rng(32)
+        B = 4096
+        x = np.stack(
+            [
+                rng.integers(0, 100, B),
+                # include the int sentinel value as REAL data
+                np.where(
+                    rng.random(B) < 0.1,
+                    np.iinfo(np.int32).max,
+                    rng.integers(-50, 50, B),
+                ),
+            ]
+        ).astype(np.int32)
+        masks = rng.random((2, B)) > 0.2
+        got = self._dedup(x, masks)
+        want = self._scatter_ref(x, masks)
+        np.testing.assert_array_equal(got, want)
+
+    def test_over_cap_falls_back_exactly(self):
+        """B must exceed DEDUP_DICT_CAP so U > D actually happens and
+        the inner scatter fallback (the correctness safety net the
+        gate design relies on) really executes."""
+        from deequ_tpu.sketches import hll
+
+        cap = hll.DEDUP_DICT_CAP
+        B = cap + 4096
+        x = np.stack(
+            [
+                np.arange(B, dtype=np.float32),  # U = B > cap: fallback
+                np.concatenate(
+                    [
+                        np.arange(cap, dtype=np.float32),
+                        np.zeros(B - cap, dtype=np.float32),
+                    ]
+                ),  # U exactly == cap: dict path at the boundary
+            ]
+        )
+        masks = np.ones((2, B), dtype=bool)
+        got = self._dedup(x, masks)
+        want = self._scatter_ref(x, masks)
+        np.testing.assert_array_equal(got, want)
+
+    def test_all_null_and_empty_gate(self):
+        from deequ_tpu.sketches import hll
+
+        B = 1024
+        x = np.zeros((1, B), dtype=np.float32)
+        masks = np.zeros((1, B), dtype=bool)
+        assert (self._dedup(x, masks) == 0).all()
+        # gate: empty registers -> False; mid-card registers -> True;
+        # saturated registers -> False
+        empty = np.zeros((1, hll.M), np.int8)
+        assert not bool(np.asarray(hll.dedup_gate(jnp.asarray(empty)))[0])
+        mid = np.zeros((1, hll.M), np.int8)
+        mid[0, : hll.M // 16] = 3  # ~1k registers touched
+        assert bool(np.asarray(hll.dedup_gate(jnp.asarray(mid)))[0])
+        full = np.full((1, hll.M), 3, np.int8)
+        assert not bool(np.asarray(hll.dedup_gate(jnp.asarray(full)))[0])
+
+    def test_adaptive_end_to_end_two_batches(self):
+        """Through the public analyzer: batch 1 (scatter, empty state)
+        then batch 2 (gated dedup) must equal a one-shot run and the
+        exact distinct count within HLL error."""
+        from deequ_tpu.analyzers import AnalysisRunner, ApproxCountDistinct
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(34)
+        n = 40_000
+        vals = np.round(rng.normal(100, 5, n) * 100) / 100  # ~3.5k uniq
+        ds = Dataset.from_pydict({"x": vals.astype(np.float32)})
+        with __import__("deequ_tpu").config.configure(batch_size=16_384):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, [ApproxCountDistinct("x")]
+            )
+        got = ctx.metric(ApproxCountDistinct("x")).value.get()
+        exact = len(np.unique(vals))
+        assert abs(got - exact) / exact < 0.05, (got, exact)
