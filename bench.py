@@ -266,27 +266,95 @@ def bench_sketches(num_rows: int):
     }
 
 
+def _tpcds_faithful(num_rows: int, num_cols: int, seed: int):
+    """A store_sales-FAITHFUL wide table: real TPC-DS measures are
+    decimal(7,2) prices (cent-quantized, ~10k distinct), small-int
+    quantities (1..100), and qty x price extended amounts — NOT
+    continuous floats. Mix per 50 cols: 10 price-like, 5 quantity,
+    5 ext-amount (high-card), 10 continuous normals (keeps the
+    high-cardinality numeric path honest), 10 int keys, 10 categorical
+    strings. The 20-col headline keeps `_tpcds_like`'s all-continuous
+    measures for round-over-round comparability."""
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(seed)
+    cols = {}
+    n_price = num_cols // 5
+    n_qty = num_cols // 10
+    n_ext = num_cols // 10
+    n_key = num_cols // 5
+    n_cat = num_cols // 5
+    n_cont = num_cols - n_price - n_qty - n_ext - n_key - n_cat
+    for i in range(n_price):
+        cents = rng.integers(50, 10_000, num_rows)  # $0.50 .. $99.99
+        vals = (cents.astype(np.float32)) / 100
+        if i % 3 == 0:
+            idx = rng.integers(0, num_rows, num_rows // 50)
+            vals[idx] = np.nan
+            cols[f"price{i}"] = pa.array(
+                vals, pa.float32(), mask=np.isnan(vals)
+            )
+        else:
+            cols[f"price{i}"] = pa.array(vals, pa.float32())
+    for i in range(n_qty):
+        cols[f"qty{i}"] = pa.array(
+            rng.integers(1, 101, num_rows, dtype=np.int64)
+        )
+    for i in range(n_ext):
+        qty = rng.integers(1, 101, num_rows)
+        cents = rng.integers(50, 10_000, num_rows)
+        cols[f"ext{i}"] = pa.array(
+            (qty * cents).astype(np.float32) / 100, pa.float32()
+        )
+    for i in range(n_cont):
+        cols[f"m{i}"] = pa.array(
+            rng.normal(100.0, 25.0, num_rows).astype(np.float32),
+            pa.float32(),
+        )
+    for i in range(n_key):
+        cols[f"k{i}"] = pa.array(
+            rng.integers(0, 10_000_000, num_rows, dtype=np.int64)
+        )
+    cats = np.array([f"cat_{j:03d}" for j in range(64)])
+    for i in range(n_cat):
+        cols[f"c{i}"] = pa.array(
+            cats[rng.integers(0, len(cats), num_rows)]
+        ).dictionary_encode()
+    return Dataset.from_arrow(pa.table(cols))
+
+
 def bench_profiler_wide(num_rows: int, num_cols: int):
-    """Compile-scaling config: a 50-col profile lowers ~300 analyzers;
-    cold_s is the number to watch (the north-star table IS 50 cols)."""
+    """The NORTH-STAR-shaped config (VERDICT r4 next #2): a first-class
+    resident measurement at 50 columns on the store_sales-faithful
+    mix, so the 1B x 50 cell-rate claim is measured, not extrapolated.
+    cold_s also tracks compile scaling (~300 analyzers)."""
     from deequ_tpu.profiles.profiler import ColumnProfiler
 
-    warm = _tpcds_like(num_rows, num_cols, seed=3)
-    cold_s, _, _, _ = _timed(lambda: ColumnProfiler.profile(warm))
-    fresh = _tpcds_like(num_rows, num_cols, seed=4)
-    wall, shipped, mbps, _ = _timed(lambda: ColumnProfiler.profile(fresh))
-    # resident rerun at the NORTH-STAR column count: the honest
-    # chip-capability number for the 1Bx50 target is rows/s at 50
-    # cols, not the 20-col headline's
-    resident_wall, _, _, _ = _timed(lambda: ColumnProfiler.profile(fresh))
+    fresh = _tpcds_faithful(num_rows, num_cols, seed=4)
+    # cold_s = compile + transfer together (one dataset keeps this
+    # config affordable); a warm-compile link rate would need a second
+    # full transfer, and the 20-col headline already measures the link
+    # properly — so no link_mb_per_sec here (it would be understated
+    # by the ~300-analyzer compile share)
+    cold_s, shipped, _, _ = _timed(lambda: ColumnProfiler.profile(fresh))
+    # resident reruns: min of two — run 2 has warm registers, so the
+    # adaptive mid-cardinality dedup path (sketches/hll.py) is active
+    # exactly as it would be on every batch but the first of a 1B run
+    r1, _, _, _ = _timed(lambda: ColumnProfiler.profile(fresh))
+    r2, _, _, _ = _timed(lambda: ColumnProfiler.profile(fresh))
+    resident_wall = min(r1, r2)
+    rate = num_rows / resident_wall
     return {
-        "wall_s": wall,
-        "cold_s": cold_s,
-        "rows_per_sec": num_rows / wall,
+        "cold_compile_plus_transfer_s": cold_s,
         "bytes_shipped": shipped,
-        "link_mb_per_sec": mbps,
         "resident_wall_s": resident_wall,
-        "resident_rows_per_sec": num_rows / resident_wall,
+        "resident_rows_per_sec": rate,
+        "ns_per_cell": 1e9 / (rate * num_cols),
+        # the link-independent projection: what the 1B x 50 north star
+        # costs at THIS chip's measured resident rate on 8 chips
+        "projected_1b_x50_resident_8chip_s": 1e9 / (rate * 8),
     }
 
 
@@ -606,7 +674,7 @@ def main():
         detail["fused_bundle_10col"] = bench_fused_bundle(8_000_000)
         detail["grouping_5cat"] = bench_grouping(4_000_000)
         detail["sketches_hll_kll"] = bench_sketches(8_000_000)
-        detail["profiler_50col"] = bench_profiler_wide(1_000_000, 50)
+        detail["profiler_50col"] = bench_profiler_wide(4_000_000, 50)
         detail["spill_grouping_12M_distinct"] = bench_spill_grouping(
             12_000_000
         )
@@ -641,6 +709,19 @@ def main():
             detail["profiler"]["resident_rows_per_sec"], 1
         ),
     }
+    # the 50-col cell-rate headline (VERDICT r4): resident rate on the
+    # north-star-shaped config plus its link-independent projection —
+    # the one number to compare round over round regardless of what
+    # the tunnel link did during the run
+    wide = detail.get("profiler_50col")
+    if isinstance(wide, dict) and "resident_rows_per_sec" in wide:
+        result["resident_rows_per_sec_50col"] = round(
+            wide["resident_rows_per_sec"], 1
+        )
+        result["ns_per_cell_50col"] = round(wide["ns_per_cell"], 2)
+        result["projected_1b_x50_resident_8chip_s"] = round(
+            wide["projected_1b_x50_resident_8chip_s"], 1
+        )
     print(json.dumps(detail, indent=2), file=sys.stderr)
     print(json.dumps(result))
 

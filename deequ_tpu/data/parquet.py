@@ -173,29 +173,37 @@ class ParquetDataset(Dataset):
             and pa.types.is_integer(arrow_type)
             and arrow_type.bit_width == 64
         ):
-            lo, hi = None, None
-            known = True
-            idx = self._source.schema.get_field_index(column)
-            for fragment in self._source.get_fragments():
-                meta = fragment.metadata
-                for rg in range(meta.num_row_groups):
-                    stats = meta.row_group(rg).column(idx).statistics
-                    if (
-                        stats is None
-                        or not stats.has_min_max
-                        or stats.min is None
-                        or stats.max is None
-                    ):
-                        known = False
-                        break
-                    lo = stats.min if lo is None else min(lo, stats.min)
-                    hi = stats.max if hi is None else max(hi, stats.max)
-                if not known:
-                    break
-            if known and lo is not None and lo >= -(2**31) and hi < 2**31:
+            rng = self._stats_min_max(column)
+            if (
+                rng is not None
+                and rng[0] >= -(2**31)
+                and rng[1] < 2**31
+            ):
                 decision = np.dtype(np.int32)
         self._values_dtypes[column] = decision
         return decision
+
+    def _stats_min_max(self, column: str):
+        """(min, max) folded over every fragment's row-group
+        statistics, or None when any group lacks them — THE one stats
+        walk (consumed by the wire-narrowing decision above and the
+        integral-range probe below)."""
+        lo, hi = None, None
+        idx = self._source.schema.get_field_index(column)
+        for fragment in self._source.get_fragments():
+            meta = fragment.metadata
+            for rg in range(meta.num_row_groups):
+                stats = meta.row_group(rg).column(idx).statistics
+                if (
+                    stats is None
+                    or not stats.has_min_max
+                    or stats.min is None
+                    or stats.max is None
+                ):
+                    return None
+                lo = stats.min if lo is None else min(lo, stats.min)
+                hi = stats.max if hi is None else max(hi, stats.max)
+        return None if lo is None else (lo, hi)
 
     def _column_arrow_type(self, column: str) -> pa.DataType:
         idx = self._source.schema.get_field_index(column)
@@ -276,6 +284,23 @@ class ParquetDataset(Dataset):
         if cap is not None and len(base) > cap:
             return None
         return base
+
+    def integral_range(self, column: str):
+        """Row-group min/max statistics make the range probe FREE for
+        parquet sources (no data scan); unknown stats -> None (treated
+        as unbounded)."""
+        if self._schema.kind_of(column) != Kind.INTEGRAL:
+            return None
+        if not hasattr(self, "_integral_ranges"):
+            self._integral_ranges = {}
+        if column not in self._integral_ranges:
+            rng = self._stats_min_max(column)
+            self._integral_ranges[column] = (
+                (int(rng[0]), int(rng[1]))
+                if rng is not None and isinstance(rng[0], int)
+                else None
+            )
+        return self._integral_ranges[column]
 
     def dictionary_size_within(self, column: str, cap: int):
         if column in self._dictionaries:

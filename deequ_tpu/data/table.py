@@ -605,6 +605,35 @@ class Dataset:
         d = self.dictionary(column)
         return len(d) if len(d) <= cap else None
 
+    def integral_range(
+        self, column: str
+    ) -> Optional[Tuple[int, int]]:
+        """(min, max) of an INTEGRAL column in one vectorized Arrow
+        pass — O(1) host memory, NO distinct set. Lets planners detect
+        a bounded value domain (TPC-DS quantity-style columns) without
+        the unbounded host dictionary the spill gate exists to avoid.
+        None for non-integral columns or all-null data. Cached: the
+        grouping planner asks once per (column, run)."""
+        if self._schema.kind_of(column) != Kind.INTEGRAL:
+            return None
+        if not hasattr(self, "_integral_ranges"):
+            self._integral_ranges: Dict[
+                str, Optional[Tuple[int, int]]
+            ] = {}
+        if column not in self._integral_ranges:
+            arr = self._table.column(column)
+            if pa.types.is_dictionary(arr.type):
+                self._integral_ranges[column] = None
+            else:
+                mm = pc.min_max(arr)
+                lo, hi = mm["min"].as_py(), mm["max"].as_py()
+                self._integral_ranges[column] = (
+                    None
+                    if lo is None or hi is None
+                    else (int(lo), int(hi))
+                )
+        return self._integral_ranges[column]
+
     def _derived_length_codes(
         self, keys: Dict[str, ColumnRequest]
     ) -> List[ColumnRequest]:

@@ -335,7 +335,15 @@ def _build_hll_group(
     members: List[Any],
     value_repr: str,  # "values" (numeric) | "codes" (string)
     where: Optional[str],
+    kll_pool_columns: Optional[Tuple[str, ...]] = None,
 ) -> ScanUnit:
+    """``kll_pool_columns``: when a KLL group with the same ``where``
+    shares the scan and covers this group's (f32-storage) columns, the
+    planner passes the KLL group's column order — the update then
+    rebuilds the KLL sort via the SAME _kll_sorted_stack construction
+    (XLA CSE executes it once) and every column takes the sorted-dedup
+    register builder unconditionally: mid-cardinality columns win from
+    batch 1, high-cardinality ones pay only the unique-count probe."""
     columns, member_cols = _index_members(members)
     where_fn, where_reqs = _compile_where(where, dataset)
     requests = [
@@ -343,6 +351,14 @@ def _build_hll_group(
         for c in columns
         for r in (ColumnRequest(c, value_repr), ColumnRequest(c, "mask"))
     ] + where_reqs
+    if kll_pool_columns:
+        # the pooled sort reads EVERY kll column: request them so the
+        # batch stays complete even if the kll unit itself degrades
+        requests += [
+            r
+            for c in kll_pool_columns
+            for r in (ColumnRequest(c, "values"), ColumnRequest(c, "mask"))
+        ]
     C = len(columns)
 
     consts = None
@@ -381,6 +397,23 @@ def _build_hll_group(
                 regs = hll.registers_from_hash_pair_stacked(
                     h1, h2, masks
                 )
+        elif kll_pool_columns:
+            # rebuild the KLL group's sort with the shared
+            # construction; XLA CSE runs it ONCE for both units
+            sorted_all, _, _ = _kll_sorted_stack(
+                batch, kll_pool_columns, where_fn
+            )
+            row_of = {c: i for i, c in enumerate(kll_pool_columns)}
+            regs = jnp.stack(
+                [
+                    hll.dedup_column_registers_from_sorted(
+                        sorted_all[row_of[c]],
+                        batch[f"{c}::values"],
+                        masks[i],
+                    )
+                    for i, c in enumerate(columns)
+                ]
+            )
         else:
             x = jnp.stack([batch[f"{c}::values"] for c in columns])
             # adaptive: sorted-dedup for mid-cardinality groups (gated
@@ -398,7 +431,11 @@ def _build_hll_group(
         )
 
     token = _group_token(
-        "hll", dataset, columns, where, extra=(value_repr,)
+        "hll",
+        dataset,
+        columns,
+        where,
+        extra=(value_repr, kll_pool_columns),
     )
     return ScanUnit(
         members,
@@ -417,6 +454,23 @@ def _build_hll_group(
 # --------------------------------------------------------------------------
 # kll family (host-folded quantile sketches)
 # --------------------------------------------------------------------------
+
+
+def _kll_sorted_stack(batch, columns, where_fn):
+    """THE one construction of the KLL group's masked f32 sort — also
+    consumed by the HLL sorted-dedup path when both units share a scan
+    (the two traces produce structurally IDENTICAL subgraphs, so XLA's
+    HLO CSE executes the sort once; a drift between two hand-written
+    copies would silently double the sort cost, hence one function).
+    Returns (sorted_x (C, B), masks, x)."""
+    masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+    masks = masks & _row_mask(batch, where_fn)[None, :]
+    x = jnp.stack(
+        [batch[f"{c}::values"].astype(jnp.float32) for c in columns]
+    )
+    masks = masks & jnp.isfinite(x)
+    sorted_x = jnp.sort(jnp.where(masks, x, jnp.inf), axis=1)
+    return sorted_x, masks, x
 
 
 def _build_kll_group(
@@ -456,14 +510,8 @@ def _build_kll_group(
     def update(_state, batch):
         # mirrors analyzers/kll._make_kll_ops exactly, vectorized over
         # the column axis; the device kernel stays in f32/u32 lanes
-        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
-        masks = masks & _row_mask(batch, where_fn)[None, :]
-        x = jnp.stack(
-            [batch[f"{c}::values"].astype(jnp.float32) for c in columns]
-        )
-        masks = masks & jnp.isfinite(x)
+        sorted_x, masks, x = _kll_sorted_stack(batch, columns, where_fn)
         B = x.shape[1]
-        sorted_x = jnp.sort(jnp.where(masks, x, jnp.inf), axis=1)
         nv = jnp.sum(masks, axis=1, dtype=jnp.int64)
         q = ((nv + k - 1) // k).astype(jnp.uint32)
         level = jnp.where(
@@ -699,6 +747,16 @@ def plan_scan_units(
             groups.setdefault(key, []).append(a)
 
     units: List[ScanUnit] = []
+    # KLL groups' column orders, per where-clause: an f32 HLL group
+    # whose columns a same-where KLL group covers rides that group's
+    # sort (see _build_hll_group's kll_pool_columns)
+    kll_pools: Dict[Optional[str], Tuple[str, ...]] = {}
+    for key, members in groups.items():
+        if key[0] == "kll" and len(members) > 1:
+            cols, _ = _index_members(members)
+            prev = kll_pools.get(key[3])
+            if prev is None or len(cols) > len(prev):
+                kll_pools[key[3]] = tuple(cols)
     for key, members in groups.items():
         if len(members) == 1:
             singles.extend(members)
@@ -713,8 +771,22 @@ def plan_scan_units(
                     _build_completeness_group(dataset, members, key[1])
                 )
             elif key[0] == "hll":
+                pool = None
+                if key[1] == "values" and key[2] == "float32":
+                    candidate = kll_pools.get(key[3])
+                    cols, _ = _index_members(members)
+                    if candidate is not None and set(cols) <= set(
+                        candidate
+                    ):
+                        pool = candidate
                 units.append(
-                    _build_hll_group(dataset, members, key[1], key[3])
+                    _build_hll_group(
+                        dataset,
+                        members,
+                        key[1],
+                        key[3],
+                        kll_pool_columns=pool,
+                    )
                 )
             elif key[0] == "kll":
                 units.append(

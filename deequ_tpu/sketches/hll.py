@@ -256,11 +256,11 @@ def _dedup_supported(dtype) -> bool:
     )
 
 
-def registers_from_sorted_dedup_stacked(
-    x: jnp.ndarray,  # (C, B) values, one dtype
-    masks: jnp.ndarray,  # (C, B) validity
+def dedup_column_registers(
+    xc: jnp.ndarray,  # (B,) values
+    maskc: jnp.ndarray,  # (B,) validity
 ) -> jnp.ndarray:
-    """(C, M) batch registers via ONE batched sort + per-column unique
+    """(M,) batch registers for ONE column via sort + unique
     compaction. Bit-identical to the per-row scatter: the dictionary
     entries are the batch's own values, hashed by the SAME
     hash_pair_numeric, and max over duplicates == single occurrence.
@@ -274,59 +274,112 @@ def registers_from_sorted_dedup_stacked(
     data, and states from the two paths still max-merge safely.
 
     A column whose ACTUAL U exceeds the cap falls back to its own full
-    scatter inside the branch (correctness never depends on the gate's
-    estimate)."""
-    C, B = x.shape
-    floating = jnp.issubdtype(x.dtype, jnp.floating)
+    scatter inside the branch (correctness never depends on the
+    caller's gate estimate)."""
+    (B,) = xc.shape
+    floating = jnp.issubdtype(xc.dtype, jnp.floating)
     D = min(DEDUP_DICT_CAP, B)
     if floating:
-        sentval = jnp.asarray(jnp.inf, x.dtype)
-        nan_mask = jnp.isnan(x)
-        keys = jnp.where(masks & ~nan_mask, x, sentval)
-        sent_flag = jnp.any((x == sentval) & masks, axis=1)
-        nan_flag = jnp.any(nan_mask & masks, axis=1)
-        nan_entry = jnp.asarray(jnp.nan, x.dtype)
+        sentval = jnp.asarray(jnp.inf, xc.dtype)
+        nan_mask = jnp.isnan(xc)
+        keys = jnp.where(maskc & ~nan_mask, xc, sentval)
+        sent_flag = jnp.any((xc == sentval) & maskc)
+        nan_flag = jnp.any(nan_mask & maskc)
+        nan_entry = jnp.asarray(jnp.nan, xc.dtype)
     else:
-        sentval = jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
-        keys = jnp.where(masks, x, sentval)
-        sent_flag = jnp.any((x == sentval) & masks, axis=1)
-        nan_flag = jnp.zeros(C, dtype=bool)
+        sentval = jnp.asarray(jnp.iinfo(xc.dtype).max, xc.dtype)
+        keys = jnp.where(maskc, xc, sentval)
+        sent_flag = jnp.any((xc == sentval) & maskc)
+        nan_flag = jnp.asarray(False)
         nan_entry = sentval  # dead slot (flag stays False)
 
-    s = jnp.sort(keys, axis=1)
+    s = jnp.sort(keys)
     uniq = jnp.concatenate(
-        [jnp.ones((C, 1), dtype=bool), s[:, 1:] != s[:, :-1]], axis=1
+        [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]]
     )
     real_u = uniq & (s < sentval)  # NaN compares False too
-    U = jnp.sum(real_u, axis=1).astype(jnp.int32)
+    U = jnp.sum(real_u).astype(jnp.int32)
 
-    targets = jnp.arange(1, D + 1, dtype=jnp.int32)
-    slot = jnp.arange(D, dtype=jnp.int32)
+    def dict_path():
+        targets = jnp.arange(1, D + 1, dtype=jnp.int32)
+        slot = jnp.arange(D, dtype=jnp.int32)
+        ranks = jnp.cumsum(real_u.astype(jnp.int32))
+        pos = jnp.searchsorted(ranks, targets)
+        entries = s[jnp.clip(pos, 0, B - 1)]
+        full = jnp.concatenate(
+            [entries, jnp.stack([sentval, nan_entry])]
+        )
+        valid = jnp.concatenate(
+            [slot < U, jnp.stack([sent_flag, nan_flag])]
+        )
+        h1, h2 = hash_pair_numeric(full)
+        return registers_from_hash_pair(h1, h2, valid)
 
-    def column_registers(c: int) -> jnp.ndarray:
-        def dict_path():
-            ranks = jnp.cumsum(real_u[c].astype(jnp.int32))
-            pos = jnp.searchsorted(ranks, targets)
-            entries = s[c][jnp.clip(pos, 0, B - 1)]
-            full = jnp.concatenate(
-                [entries, jnp.stack([sentval, nan_entry])]
-            )
-            valid = jnp.concatenate(
-                [
-                    slot < U[c],
-                    jnp.stack([sent_flag[c], nan_flag[c]]),
-                ]
-            )
-            h1, h2 = hash_pair_numeric(full)
-            return registers_from_hash_pair(h1, h2, valid)
+    def scatter_path():
+        h1, h2 = hash_pair_numeric(xc)
+        return registers_from_hash_pair(h1, h2, maskc)
 
-        def scatter_path():
-            h1, h2 = hash_pair_numeric(x[c])
-            return registers_from_hash_pair(h1, h2, masks[c])
+    return jax.lax.cond(U <= D, dict_path, scatter_path)
 
-        return jax.lax.cond(U[c] <= D, dict_path, scatter_path)
 
-    return jnp.stack([column_registers(c) for c in range(C)])
+def dedup_column_registers_from_sorted(
+    s: jnp.ndarray,  # (B,) PRE-SORTED keys: invalid/non-finite -> +inf
+    xc: jnp.ndarray,  # (B,) raw values (flag probes + fallback scatter)
+    maskc: jnp.ndarray,  # (B,) validity
+) -> jnp.ndarray:
+    """(M,) batch registers from an ALREADY-SORTED key array — the
+    KLL group's masked f32 sort (engine/vectorize._kll_sorted_stack),
+    which maps nulls AND every non-finite value to the +inf sentinel.
+    The three non-finite values (+inf, -inf, NaN) are therefore absent
+    from the unique run and re-enter as flagged extra dictionary
+    slots, probed from the raw column. Bit-identity caveats match
+    dedup_column_registers (canonical-NaN collapse)."""
+    (B,) = s.shape
+    D = min(DEDUP_DICT_CAP, B)
+    sentval = jnp.asarray(jnp.inf, s.dtype)
+    uniq = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]]
+    )
+    real_u = uniq & (s < sentval)
+    U = jnp.sum(real_u).astype(jnp.int32)
+    pos_inf = jnp.any((xc == jnp.inf) & maskc)
+    neg_inf = jnp.any((xc == -jnp.inf) & maskc)
+    nan_flag = jnp.any(jnp.isnan(xc) & maskc)
+
+    def dict_path():
+        targets = jnp.arange(1, D + 1, dtype=jnp.int32)
+        slot = jnp.arange(D, dtype=jnp.int32)
+        ranks = jnp.cumsum(real_u.astype(jnp.int32))
+        pos = jnp.searchsorted(ranks, targets)
+        entries = s[jnp.clip(pos, 0, B - 1)]
+        extras = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], s.dtype)
+        full = jnp.concatenate([entries, extras])
+        valid = jnp.concatenate(
+            [slot < U, jnp.stack([pos_inf, neg_inf, nan_flag])]
+        )
+        h1, h2 = hash_pair_numeric(full)
+        return registers_from_hash_pair(h1, h2, valid)
+
+    def scatter_path():
+        h1, h2 = hash_pair_numeric(xc)
+        return registers_from_hash_pair(h1, h2, maskc)
+
+    return jax.lax.cond(U <= D, dict_path, scatter_path)
+
+
+def registers_from_sorted_dedup_stacked(
+    x: jnp.ndarray,  # (C, B) values, one dtype
+    masks: jnp.ndarray,  # (C, B) validity
+) -> jnp.ndarray:
+    """(C, M) batch registers, every column through the sorted-dedup
+    builder (no gating) — the differential-test surface for
+    dedup_column_registers."""
+    return jnp.stack(
+        [
+            dedup_column_registers(x[c], masks[c])
+            for c in range(x.shape[0])
+        ]
+    )
 
 
 def numeric_registers_adaptive(
@@ -334,11 +387,16 @@ def numeric_registers_adaptive(
     masks: jnp.ndarray,  # (C, B) validity
     prev_registers: jnp.ndarray,  # (C, M) carried state
 ) -> jnp.ndarray:
-    """THE numeric register builder: full stacked scatter by default;
-    the sorted-dedup branch when the carried state says at least half
-    the group's columns are mid-cardinality (the batched sort is paid
-    once for the whole group, so a lone mid-card column among
-    high-card ones is not worth it)."""
+    """THE numeric register builder. Default: ONE stacked flat scatter
+    for the whole group. When the carried state says ANY column is
+    mid-cardinality, the group switches to per-column dispatch where
+    each gated column pays ITS OWN sort + unique compaction (~8 ms vs
+    ~15 ms scatter at B=2^21) and ungated columns keep a plain scatter
+    — a high-cardinality column never pays for its mid-card neighbors
+    (the r5 batched-sort-for-the-whole-group variant measured a net
+    LOSS on mixed groups for exactly that reason). Both layouts
+    scatter at the same per-element rate (PERF.md r4: banked splits ==
+    stacked)."""
     if not _dedup_supported(x.dtype):
         h1, h2 = hash_pair_numeric(x)
         return registers_from_hash_pair_stacked(h1, h2, masks)
@@ -349,12 +407,24 @@ def numeric_registers_adaptive(
         h1, h2 = hash_pair_numeric(x)
         return registers_from_hash_pair_stacked(h1, h2, masks)
 
-    def dedup_all():
-        return registers_from_sorted_dedup_stacked(x, masks)
+    def per_column():
+        outs = []
+        for c in range(C):
+            outs.append(
+                jax.lax.cond(
+                    gate[c],
+                    lambda c=c: dedup_column_registers(x[c], masks[c]),
+                    lambda c=c: _scatter_column(x[c], masks[c]),
+                )
+            )
+        return jnp.stack(outs)
 
-    return jax.lax.cond(
-        jnp.sum(gate) * 2 >= max(C, 1), dedup_all, scatter_all
-    )
+    return jax.lax.cond(jnp.any(gate), per_column, scatter_all)
+
+
+def _scatter_column(xc: jnp.ndarray, maskc: jnp.ndarray) -> jnp.ndarray:
+    h1, h2 = hash_pair_numeric(xc)
+    return registers_from_hash_pair(h1, h2, maskc)
 
 
 _Q = 32  # h2 supplies 32 bits => register ranks 0..Q+1

@@ -517,3 +517,81 @@ class TestSortedDedupRegisters:
         got = ctx.metric(ApproxCountDistinct("x")).value.get()
         exact = len(np.unique(vals))
         assert abs(got - exact) / exact < 0.05, (got, exact)
+
+
+class TestDedupFromSortedPool:
+    """The pooled variant (dedup from the KLL group's pre-sorted keys,
+    where nulls AND every non-finite value sort as +inf) must also be
+    bit-identical to the per-row scatter — incl. real -inf, which the
+    pool sort hides and the flag path must re-add."""
+
+    def test_pool_variant_matches_scatter(self):
+        from deequ_tpu.sketches import hll
+
+        rng = np.random.default_rng(41)
+        B = 8192
+        rows = [
+            np.round(rng.normal(100, 25, B) * 100).astype(np.float32)
+            / 100,
+            np.array(
+                [np.inf, -np.inf, np.nan, -0.0, 0.0, 7.25] * (B // 6)
+                + [7.25] * (B % 6),
+                dtype=np.float32,
+            ),
+            rng.normal(0, 1, B).astype(np.float32),  # high-card
+        ]
+        for xc in rows:
+            maskc = rng.random(B) > 0.15
+            s = np.sort(
+                np.where(
+                    maskc & np.isfinite(xc), xc, np.float32(np.inf)
+                )
+            )
+            got = np.asarray(
+                hll.dedup_column_registers_from_sorted(
+                    jnp.asarray(s), jnp.asarray(xc), jnp.asarray(maskc)
+                )
+            )
+            h1, h2 = hll.hash_pair_numeric(jnp.asarray(xc))
+            want = np.asarray(
+                hll.registers_from_hash_pair(h1, h2, jnp.asarray(maskc))
+            )
+            np.testing.assert_array_equal(got, want)
+
+    def test_profiler_pool_equality_end_to_end(self):
+        """A profile with KLL + HLL co-planned (the pool fires) must
+        report the same ApproxCountDistinct as a run with the analyzer
+        alone (scatter path)."""
+        from deequ_tpu.analyzers import (
+            AnalysisRunner,
+            ApproxCountDistinct,
+            ApproxQuantiles,
+        )
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(42)
+        n = 30_000
+        ds = Dataset.from_pydict(
+            {
+                "p1": (
+                    np.round(rng.normal(50, 9, n) * 100) / 100
+                ).astype(np.float32),
+                "p2": rng.normal(0, 1, n).astype(np.float32),
+            }
+        )
+        together = AnalysisRunner.do_analysis_run(
+            ds,
+            [
+                ApproxCountDistinct("p1"),
+                ApproxCountDistinct("p2"),
+                ApproxQuantiles("p1", [0.5]),
+                ApproxQuantiles("p2", [0.5]),
+            ],
+        )
+        for col in ("p1", "p2"):
+            alone = AnalysisRunner.do_analysis_run(
+                ds, [ApproxCountDistinct(col)]
+            )
+            a = together.metric(ApproxCountDistinct(col)).value.get()
+            b = alone.metric(ApproxCountDistinct(col)).value.get()
+            assert a == b, (col, a, b)

@@ -278,9 +278,11 @@ class TestSpillStateInterop:
             ), a
 
     def test_spill_event_recorded_in_run_metadata(self):
+        # key range must exceed DENSE_DOMAIN_RANGE: bounded-domain
+        # integers now (r5) ride the dense fused scan instead
         rng = np.random.default_rng(3)
         ds = Dataset.from_pydict(
-            {"id": list(rng.integers(0, 100, 1_000, dtype=np.int64))}
+            {"id": list(rng.integers(0, 10**7, 1_000, dtype=np.int64))}
         )
         with config.configure(device_spill_grouping=True):
             ctx = AnalysisRunner.do_analysis_run(ds, [Uniqueness("id")])
@@ -506,3 +508,28 @@ class TestR4JointExtensions:
             d, h = ctx_mesh.metric(z).value, ctx_host.metric(z).value
             assert d.is_success and h.is_success, (z, d, h)
             assert d.get() == pytest.approx(h.get(), rel=1e-9), z
+
+
+class TestDenseDomainGate:
+    """Bounded-domain integers (TPC-DS quantity shape) must ride the
+    dense fused scan — the r5 range gate — with results equal to both
+    the sort path it replaced and the host Arrow path."""
+
+    def test_small_range_ints_stay_dense_and_exact(self):
+        rng = np.random.default_rng(11)
+        vals = rng.integers(1, 101, 50_000, dtype=np.int64)
+        ds = Dataset.from_pydict({"q": list(vals)})
+        with config.configure(device_spill_grouping=True):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, [Uniqueness("q"), CountDistinct("q")]
+            )
+        assert not any(
+            e.get("event") == "grouping_spill"
+            for e in ctx.run_metadata.events
+        ), ctx.run_metadata.events
+        with config.configure(device_spill_grouping=False):
+            want = AnalysisRunner.do_analysis_run(
+                ds, [Uniqueness("q"), CountDistinct("q")]
+            )
+        for a in (Uniqueness("q"), CountDistinct("q")):
+            assert ctx.metric(a).value.get() == want.metric(a).value.get()
