@@ -316,8 +316,7 @@ def dedup_column_registers(
         return registers_from_hash_pair(h1, h2, valid)
 
     def scatter_path():
-        h1, h2 = hash_pair_numeric(xc)
-        return registers_from_hash_pair(h1, h2, maskc)
+        return _scatter_column(xc, maskc)
 
     return jax.lax.cond(U <= D, dict_path, scatter_path)
 
@@ -361,8 +360,7 @@ def dedup_column_registers_from_sorted(
         return registers_from_hash_pair(h1, h2, valid)
 
     def scatter_path():
-        h1, h2 = hash_pair_numeric(xc)
-        return registers_from_hash_pair(h1, h2, maskc)
+        return _scatter_column(xc, maskc)
 
     return jax.lax.cond(U <= D, dict_path, scatter_path)
 

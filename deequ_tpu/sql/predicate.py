@@ -28,31 +28,34 @@ SURVEY.md §2.2 Compliance = "arbitrary SQL predicate"):
 | [NOT] IN (...) | string or numeric item lists |
 | BETWEEN x AND y | |
 | [NOT] LIKE 'pat%' / RLIKE 're' | host regex over the dictionary |
-| CASE WHEN c THEN v ... [ELSE v] END | numeric/bool branch values |
-| COALESCE(a, b, ...) | numeric/bool arguments |
+| CASE WHEN c THEN v ... [ELSE v] END | numeric/bool OR string branch values (homogeneous) |
+| COALESCE(a, b, ...) | numeric/bool OR string arguments (homogeneous) |
 | ABS(x) | |
-| LENGTH(s) | also over TRIM/UPPER/... results |
+| LENGTH(s) | also over TRIM/UPPER/... and CASE/CONCAT results |
 | TRIM/LTRIM/RTRIM(s) | host transform over the dictionary |
 | UPPER(s) / LOWER(s) | compose freely, e.g. UPPER(TRIM(s)) |
 | SUBSTR/SUBSTRING(s, pos[, len]) | Spark 1-based semantics |
-| CONCAT(...) | at most one column operand, literals around it |
-| CAST(x AS INT/BIGINT/DOUBLE/...) | numeric targets; string operands parse per dictionary entry, unparseable -> NULL |
+| CONCAT(...) | any mix of string columns/expressions and literals (cross-dictionary product bounded by a 65536-entry plan budget) |
+| CAST(x AS INT/BIGINT/DOUBLE/...) | string operands parse per dictionary entry, unparseable -> NULL; timestamp columns -> epoch SECONDS (floor for integral targets) |
+| CAST(x AS STRING) | string operands (identity) and boolean columns ('true'/'false') |
 | ts_col <op> 'YYYY-MM-DD[ HH:MM:SS]' | date literal in the column's unit |
 | DATE_ADD(ts_col, n) / DATE_SUB | shifts by whole days in the column's unit |
 | DATEDIFF(a, b) | UTC-day difference; timestamp columns and/or date literals |
 | literals | numbers, 'strings', TRUE/FALSE/NULL |
 
 String functions never reach the device: they evaluate host-side over
-the (small) column dictionary, composing into per-code lookup tables;
-the device work stays a gather over codes (SURVEY.md §7 hard part #3).
-Unsupported syntax fails at PLANNING time (PredicateParseError), which
-the runner degrades to that analyzer's failure metric — never a crash
-mid-scan.
+the (small) column dictionary, composing into per-code lookup tables —
+string-valued CASE/COALESCE, multi-column CONCAT and CAST(bool AS
+STRING) build SYNTHETIC dictionaries (union / cross-product /
+'true'-'false') whose codes the device selects with the same gathers
+(SURVEY.md §7 hard part #3). Unsupported syntax fails at PLANNING time
+(PredicateParseError), which the runner degrades to that analyzer's
+failure metric — never a crash mid-scan.
 
 Known not-yet-implemented vs full Spark SQL (documented, degrade
-cleanly): string-valued CASE/COALESCE results, multi-column CONCAT,
-CAST to STRING or of timestamps, timezone-aware date semantics
-(DATEDIFF counts UTC days).
+cleanly): timezone-aware date semantics (DATEDIFF counts UTC days),
+and CAST of numeric/timestamp VALUES to STRING (Java number/timestamp
+formatting; compare numerically instead).
 """
 
 from __future__ import annotations
@@ -470,6 +473,11 @@ class _Val:
     # UPPER/LOWER/SUBSTR chains): consumers build per-code LUTs from
     # transform(dict[i]) instead of dict[i]; None = raw values
     transform: Optional[Callable[[str], str]] = None
+    # SYNTHETIC string lane (string-valued CASE/COALESCE, multi-column
+    # CONCAT, CAST(bool AS STRING)): ``values`` are codes into this
+    # tuple instead of a column dictionary; entries may be None for
+    # never-selected slots (row validity governs). codes_of stays None.
+    entries: Optional[Tuple[Optional[str], ...]] = None
     # timestamp/date lane: ``ts_per_day`` = how many epoch units make
     # one UTC day (set for TIMESTAMP/date columns and DATE_ADD results;
     # 1 = day-valued). Comparisons convert string literals into this
@@ -601,6 +609,7 @@ def compile_predicate(expression: str, dataset: Dataset) -> CompiledPredicate:
     # the shared fused-scan trace, would poison every co-scheduled
     # analyzer in the pass
     _check_types(node, schema)
+    _check_plan_budgets(node, dataset)
     compiled = CompiledPredicate(node, dataset, cols, requests)
     cache[expression] = compiled
     return compiled
@@ -641,23 +650,18 @@ def _check_types(node: Node, schema) -> str:
             check_cmp(n.operand, n.high)
             return "value"
         if isinstance(n, CaseWhen):
-            for cond, result in n.whens:
+            results = [r for _, r in n.whens]
+            if n.else_ is not None:
+                results.append(n.else_)
+            for cond, _ in n.whens:
                 if kind_of(cond) in ("string", "stringlit"):
                     raise PredicateParseError(
                         "a CASE condition must be boolean, not a bare "
                         "string operand"
                     )
-                if kind_of(result) in ("string", "stringlit"):
-                    raise PredicateParseError(
-                        "string-valued CASE results are not supported"
-                    )
-            if n.else_ is not None and kind_of(n.else_) in (
-                "string", "stringlit",
-            ):
-                raise PredicateParseError(
-                    "string-valued CASE results are not supported"
-                )
-            return "value"
+            return _homogeneous_branches(
+                [kind_of(r) for r in results], "CASE"
+            )
         if isinstance(n, InList):
             base = kind_of(n.operand)
             for item in n.items:
@@ -678,26 +682,42 @@ def _check_types(node: Node, schema) -> str:
                 raise PredicateParseError("LIKE requires a string column")
             return "value"
         if isinstance(n, Cast):
-            if n.type_name not in _CAST_TYPES:
+            if (
+                n.type_name not in _CAST_TYPES
+                and n.type_name not in _STRING_CASTS
+            ):
                 raise PredicateParseError(
                     f"CAST to {n.type_name} is not supported "
-                    "(numeric targets only)"
+                    "(numeric or STRING targets)"
                 )
             k = kind_of(n.operand)
             if k == "stringlit":
                 raise PredicateParseError(
                     "CAST of a string literal is constant"
                 )
-            if k == "timestamp":
-                # raw epoch values are in the STORAGE unit (us/ns/...);
-                # Spark's cast(timestamp as bigint) yields SECONDS —
-                # returning unit-dependent numbers would be silently
-                # wrong, so refuse (compare against date literals
-                # instead, which convert through the column's unit)
+            if n.type_name in _STRING_CASTS:
+                if k == "string":
+                    return "string"
+                if (
+                    isinstance(n.operand, ColumnRef)
+                    and schema.kind_of(n.operand.name) == Kind.BOOLEAN
+                ):
+                    return "string"
                 raise PredicateParseError(
-                    "CAST of a timestamp column is not supported — "
-                    "compare against 'YYYY-MM-DD' literals instead"
+                    "CAST to STRING supports string and boolean "
+                    "operands only (numeric/timestamp formatting is "
+                    "not supported)"
                 )
+            if k == "timestamp" and not isinstance(n.operand, ColumnRef):
+                # day-valued DATE_ADD/DATE_SUB results are DATEs;
+                # Spark refuses date -> numeric casts
+                raise PredicateParseError(
+                    "CAST of a date value to a number is not "
+                    "supported (Spark refuses date -> numeric)"
+                )
+            # timestamp COLUMNS cast to epoch seconds (Spark); the
+            # date-typed-column refusal needs the arrow type and lives
+            # in _check_plan_budgets
             return "value"
         if isinstance(n, FuncCall):
             # the predicate evaluator supports only these functions;
@@ -760,12 +780,9 @@ def _check_types(node: Node, schema) -> str:
                     raise PredicateParseError(
                         "CONCAT of only literals is constant"
                     )
-                if col_args > 1:
-                    raise PredicateParseError(
-                        "CONCAT supports at most ONE column operand "
-                        "(cross-dictionary concatenation is not "
-                        "supported)"
-                    )
+                # multi-column CONCAT builds a cross-product synthetic
+                # dictionary; its SIZE is validated against the plan
+                # budget in _check_plan_budgets (needs dictionaries)
                 return "string"
             for a in n.args:
                 if isinstance(a, StarLit):
@@ -794,13 +811,13 @@ def _check_types(node: Node, schema) -> str:
                     )
                 return "string"
             if n.name == "COALESCE":
-                for a in n.args:
-                    if kind_of(a) in ("string", "stringlit"):
-                        raise PredicateParseError(
-                            "COALESCE over string columns is not "
-                            "supported (numeric/boolean arguments only)"
-                        )
-                return "value"
+                if not n.args:
+                    raise PredicateParseError(
+                        "COALESCE needs arguments"
+                    )
+                return _homogeneous_branches(
+                    [kind_of(a) for a in n.args], "COALESCE"
+                )
             if n.name == "LENGTH":
                 for a in n.args:
                     kind_of(a)
@@ -870,6 +887,80 @@ def _check_types(node: Node, schema) -> str:
     return kind_of(node)
 
 
+def _homogeneous_branches(kinds: List[str], what: str) -> str:
+    """CASE/COALESCE result branches must all be stringish or all
+    value-ish (NULLs are neutral); returns the result kind."""
+    stringish = [k for k in kinds if k in ("string", "stringlit")]
+    valueish = [k for k in kinds if k in ("value", "timestamp")]
+    if stringish and valueish:
+        raise PredicateParseError(
+            f"{what} branches mix string and non-string results"
+        )
+    return "string" if stringish else "value"
+
+
+def _estimated_entries(node: Node, dataset: Dataset) -> int:
+    """Upper bound on a string expression's dictionary size (plan
+    time): column lanes count their dictionary, CONCAT multiplies,
+    CASE/COALESCE unions sum, literals are 1."""
+    if isinstance(node, StringLit):
+        return 1
+    if isinstance(node, ColumnRef):
+        return len(dataset.dictionary(node.name))
+    if isinstance(node, FuncCall):
+        if node.name == "CONCAT":
+            total = 1
+            for a in node.args:
+                e = _estimated_entries(a, dataset)
+                if e > 1:  # literals fold into neighbors
+                    total *= e
+            return total
+        if node.name == "COALESCE":
+            return sum(
+                _estimated_entries(a, dataset) for a in node.args
+            )
+        if node.name in _STRING_FNS:
+            return _estimated_entries(node.args[0], dataset)
+    if isinstance(node, CaseWhen):
+        total = sum(
+            _estimated_entries(r, dataset) for _, r in node.whens
+        )
+        if node.else_ is not None:
+            total += _estimated_entries(node.else_, dataset)
+        return total
+    if isinstance(node, Cast):  # CAST(s AS STRING) is identity
+        return _estimated_entries(node.operand, dataset)
+    return 2  # bool lanes etc.
+
+
+def _check_plan_budgets(node: Node, dataset: Dataset) -> None:
+    """Dictionary-dependent plan-time validation (runs after the
+    static type check, with the dataset in hand): CONCAT cross-product
+    budgets and the date-typed-column CAST refusal."""
+    if isinstance(node, FuncCall) and node.name == "CONCAT":
+        est = _estimated_entries(node, dataset)
+        if est > _CONCAT_DICT_BUDGET:
+            raise PredicateParseError(
+                f"CONCAT cross-dictionary size ~{est} exceeds the "
+                f"{_CONCAT_DICT_BUDGET}-entry plan budget"
+            )
+    if (
+        isinstance(node, Cast)
+        and node.type_name in _CAST_TYPES
+        and isinstance(node.operand, ColumnRef)
+        and dataset.schema.kind_of(node.operand.name) == Kind.TIMESTAMP
+    ):
+        import pyarrow as pa
+
+        if pa.types.is_date(dataset._column_arrow_type(node.operand.name)):
+            raise PredicateParseError(
+                "CAST of a DATE column to a number is not supported "
+                "(Spark refuses date -> numeric)"
+            )
+    for child in _children_of(node):
+        _check_plan_budgets(child, dataset)
+
+
 def _children_of(node: Node):
     """Every child Node, uniformly across node shapes (incl. CASE)."""
     for attr in ("operand", "left", "right", "low", "high", "else_"):
@@ -923,6 +1014,23 @@ _CMP_FNS = {
 }
 
 
+def _is_string_lane(v: "_Val") -> bool:
+    """Column-backed (codes_of) OR synthetic (entries) string lane."""
+    return v.codes_of is not None or v.entries is not None
+
+
+def _lane_entries(ds, v: "_Val") -> "list[Optional[str]]":
+    """The lane's dictionary as the EXPRESSION sees it: synthetic
+    entries verbatim (transforms were folded in at construction);
+    column-backed entries through the composed view."""
+    if v.entries is not None:
+        return list(v.entries)
+    return [
+        None if x is None else v.view(str(x))
+        for x in ds.dictionary(v.codes_of)
+    ]
+
+
 def _dict_lookup(dataset: Dataset, column: str, value: str) -> int:
     dictionary = dataset.dictionary(column)
     matches = np.nonzero(dictionary == value)[0]
@@ -930,17 +1038,18 @@ def _dict_lookup(dataset: Dataset, column: str, value: str) -> int:
 
 
 def _string_eq_lut(ds: Dataset, base: "_Val", literal: str) -> jnp.ndarray:
-    """Per-code bool LUT for ``view(dict[i]) == literal`` — required
+    """Per-code bool LUT for ``view(entry[i]) == literal`` — required
     when a transform applies (several raw entries may map to the same
-    transformed value, so a single-code lookup can't represent it)."""
-    dictionary = ds.dictionary(base.codes_of)
-    table = np.zeros(len(dictionary) + 1, dtype=bool)
-    for i, s in enumerate(dictionary):
-        if s is not None and base.view(str(s)) == literal:
+    transformed value, so a single-code lookup can't represent it) and
+    for synthetic lanes."""
+    view = _lane_entries(ds, base)
+    table = np.zeros(len(view) + 1, dtype=bool)
+    for i, s in enumerate(view):
+        if s is not None and s == literal:
             table[i] = True
     lut = jnp.asarray(table)
-    idx = jnp.where(base.values < 0, len(dictionary), base.values)
-    return lut[jnp.clip(idx, 0, len(dictionary))]
+    idx = jnp.where(base.values < 0, len(view), base.values)
+    return lut[jnp.clip(idx, 0, len(view))]
 
 
 def _rank_table(
@@ -957,11 +1066,9 @@ def _rank_table(
 
 
 def _dict_view(ds: Dataset, val: "_Val") -> "list[Optional[str]]":
-    """The dictionary as the expression sees it: transform applied."""
-    return [
-        None if v is None else val.view(str(v))
-        for v in ds.dictionary(val.codes_of)
-    ]
+    """The dictionary as the expression sees it: transform applied
+    (synthetic lanes included)."""
+    return _lane_entries(ds, val)
 
 
 def _ranks_for(
@@ -1004,6 +1111,10 @@ _CAST_TYPES = (
     "FLOAT", "DOUBLE", "REAL",
 )
 _INT_CASTS = ("INT", "INTEGER", "BIGINT", "LONG", "SMALLINT", "TINYINT")
+_STRING_CASTS = ("STRING", "VARCHAR", "TEXT")
+# cap on a synthetic cross-product dictionary (multi-column CONCAT):
+# host-side string materialization + per-code LUT sizes stay bounded
+_CONCAT_DICT_BUDGET = 1 << 16
 # JVM d2i-style saturation bounds per integral target (f64 lane: the
 # i64 bounds round to the nearest representable double)
 _INT_CAST_BOUNDS = {
@@ -1082,6 +1193,17 @@ def _eval_string_fn(
         def transform(s: str, _fn=fn, _inner=inner):
             return _fn(_inner(s))
 
+    if base.entries is not None:
+        # synthetic lane: entries are final strings — apply the
+        # function eagerly instead of composing a lazy transform
+        return _Val(
+            base.values,
+            base.valid,
+            entries=tuple(
+                None if e is None else transform(e)
+                for e in base.entries
+            ),
+        )
     if base.codes_of is None:
         raise PredicateParseError(
             f"{node.name} requires a string column operand"
@@ -1142,6 +1264,72 @@ def _date_literal_epoch(ds, column: str, literal: str) -> int:
     return int(pc.cast(arr, pa.int64())[0].as_py())
 
 
+def _eval_stringish(node: Node, batch, ds):
+    """Branch evaluation for CASE/COALESCE, where a bare string
+    literal (or NULL) is a legal RESULT: literals become ('lit', s)
+    markers instead of erroring, everything else evaluates."""
+    if isinstance(node, StringLit):
+        return ("lit", node.value)
+    if isinstance(node, NullLit):
+        return ("null",)
+    return _eval(node, batch, ds)
+
+
+def _any_stringish(branches) -> bool:
+    return any(
+        (isinstance(b, tuple) and b[0] == "lit")
+        or (isinstance(b, _Val) and _is_string_lane(b))
+        for b in branches
+    )
+
+
+def _string_union(ds, branches):
+    """Union synthetic dictionary over string branches + each branch
+    as (union codes, valid). Branches: ('lit', s) | ('null',) | string
+    _Val lanes (homogeneity is enforced at plan time; a numeric _Val
+    here means the checker missed a case — refuse loudly)."""
+    values: set = set()
+    views: List[Optional[List[Optional[str]]]] = []
+    for b in branches:
+        if isinstance(b, tuple):
+            views.append(None)
+            if b[0] == "lit":
+                values.add(b[1])
+        elif _is_string_lane(b):
+            view = _lane_entries(ds, b)
+            views.append(view)
+            values.update(v for v in view if v is not None)
+        else:
+            raise PredicateParseError(
+                "CASE/COALESCE branches mix string and non-string "
+                "results"
+            )
+    union = sorted(values)
+    index = {v: i for i, v in enumerate(union)}
+    out = []
+    for b, view in zip(branches, views):
+        if isinstance(b, tuple):
+            if b[0] == "lit":
+                out.append(
+                    (jnp.int32(index[b[1]]), jnp.asarray(True))
+                )
+            else:
+                out.append((jnp.int32(0), jnp.asarray(False)))
+        else:
+            lut = np.zeros(len(view) + 1, dtype=np.int32)
+            for i, v in enumerate(view):
+                if v is not None:
+                    lut[i] = index[v]
+            table = jnp.asarray(lut)
+            idx = jnp.clip(
+                jnp.where(b.values < 0, len(view), b.values),
+                0,
+                len(view),
+            )
+            out.append((table[idx], b.valid))
+    return union, out
+
+
 def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
     if isinstance(node, ColumnRef):
         kind = ds.schema.kind_of(node.name)
@@ -1193,19 +1381,52 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         )
     if isinstance(node, Cast):
         v = _eval(node.operand, batch, ds)
+        if node.type_name in _STRING_CASTS:
+            if _is_string_lane(v):
+                return v  # identity (transform/entries preserved)
+            if v.is_bool:
+                # Spark: cast(true AS STRING) = 'true'
+                return _Val(
+                    v.values.astype(jnp.int32),
+                    v.valid,
+                    entries=("false", "true"),
+                )
+            raise PredicateParseError(
+                "CAST to STRING supports string and boolean operands "
+                "only (numeric/timestamp formatting is not supported)"
+            )
         integral = node.type_name in _INT_CASTS
-        if v.codes_of is not None:
-            # string column: parse each dictionary entry ONCE
+        if v.ts_per_day is not None:
+            # Spark: cast(timestamp AS BIGINT/DOUBLE) = epoch SECONDS
+            # (floor for integral targets, then the same saturation
+            # bounds every integral cast applies); date operands are
+            # refused at plan time like Spark's analyzer does
+            upd = v.ts_per_day // 86_400  # units per second
+            raw = v.values.astype(jnp.int64)
+            if integral:
+                lo, hi = _INT_CAST_BOUNDS[node.type_name]
+                vals = jnp.clip(
+                    jnp.floor_divide(raw, jnp.int64(upd)).astype(
+                        jnp.float64
+                    ),
+                    lo,
+                    hi,
+                )
+            else:
+                vals = raw.astype(jnp.float64) / float(upd)
+            return _Val(vals, v.valid)
+        if _is_string_lane(v):
+            # string lane: parse each dictionary entry ONCE
             # (Spark cast semantics: unparseable -> NULL). Validity
             # lives in its OWN table — overloading NaN as the invalid
             # sentinel would misreport an entry 'NaN' (which Spark
             # casts to the VALUE NaN) as NULL (r4 advisory).
-            dictionary = ds.dictionary(v.codes_of)
-            table = np.zeros(len(dictionary) + 1)
-            ok = np.zeros(len(dictionary) + 1, dtype=bool)
-            for i, s in enumerate(dictionary):
+            view = _lane_entries(ds, v)
+            table = np.zeros(len(view) + 1)
+            ok = np.zeros(len(view) + 1, dtype=bool)
+            for i, s in enumerate(view):
                 if s is not None:
-                    text = v.view(str(s)).strip()
+                    text = s.strip()
                     if "_" in text:  # Python-only numeric syntax
                         continue  # ('1_0'); Spark casts it to NULL
                     try:
@@ -1216,9 +1437,9 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             lut = jnp.asarray(table)
             ok_lut = jnp.asarray(ok)
             idx = jnp.clip(
-                jnp.where(v.values < 0, len(dictionary), v.values),
+                jnp.where(v.values < 0, len(view), v.values),
                 0,
-                len(dictionary),
+                len(view),
             )
             vals = lut[idx]
             valid = v.valid & ok_lut[idx]
@@ -1245,31 +1466,47 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
     if isinstance(node, CaseWhen):
         # SQL: first branch whose condition is TRUE wins (NULL
         # conditions skip); no match and no ELSE -> NULL. Folded in
-        # reverse so earlier branches override later ones.
-        if node.else_ is not None:
-            acc = _eval(node.else_, batch, ds)
-        else:
-            acc = _Val(jnp.asarray(0.0), jnp.asarray(False))
-        if acc.codes_of is not None:
-            raise PredicateParseError(
-                "string-valued CASE results are not supported"
+        # reverse so earlier branches override later ones. String-
+        # valued results (homogeneous, enforced at plan time) fold the
+        # same way over codes into a UNION synthetic dictionary.
+        branches = [
+            (cond, _eval_stringish(r, batch, ds))
+            for cond, r in node.whens
+        ]
+        else_b = (
+            _eval_stringish(node.else_, batch, ds)
+            if node.else_ is not None
+            else ("null",)
+        )
+        if _any_stringish([b for _, b in branches] + [else_b]):
+            union, codes_of_branch = _string_union(
+                ds, [b for _, b in branches] + [else_b]
             )
-        # branch values coerce to f64 (SQL promotes mixed numeric/bool
-        # CASE branches); truth of the result is still `!= 0`
-        vals = jnp.asarray(acc.values, dtype=jnp.float64)
-        valid = acc.valid
-        for cond, result in reversed(node.whens):
+            vals, valid = codes_of_branch[-1]
+            for (cond, _), (bc, bv) in zip(
+                reversed(branches), reversed(codes_of_branch[:-1])
+            ):
+                ct, cv = _as_bool(_eval(cond, batch, ds))
+                hit = ct & cv
+                vals = jnp.where(hit, bc, vals)
+                valid = jnp.where(hit, bv, valid)
+            return _Val(vals, valid, entries=tuple(union))
+
+        # numeric fold, REUSING the already-evaluated branches (a
+        # ('null',) marker is an invalid slot); branch values coerce
+        # to f64 (SQL promotes mixed numeric/bool CASE branches)
+        def as_num(b):
+            if isinstance(b, tuple):  # ('null',)
+                return jnp.asarray(0.0), jnp.asarray(False)
+            return jnp.asarray(b.values, dtype=jnp.float64), b.valid
+
+        vals, valid = as_num(else_b)
+        for (cond, _), b in zip(reversed(node.whens), reversed(branches)):
             ct, cv = _as_bool(_eval(cond, batch, ds))
             hit = ct & cv
-            r = _eval(result, batch, ds)
-            if r.codes_of is not None:
-                raise PredicateParseError(
-                    "string-valued CASE results are not supported"
-                )
-            vals = jnp.where(
-                hit, jnp.asarray(r.values, dtype=jnp.float64), vals
-            )
-            valid = jnp.where(hit, r.valid, valid)
+            bv, bok = as_num(b[1])
+            vals = jnp.where(hit, bv, vals)
+            valid = jnp.where(hit, bok, valid)
         return _Val(vals, valid)
     if isinstance(node, InList):
         base = _eval(node.operand, batch, ds)
@@ -1280,11 +1517,11 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 # SQL: x IN (..., NULL) is TRUE on a match, else NULL
                 has_null_item = True
             elif isinstance(item, StringLit):
-                if base.codes_of is None:
+                if not _is_string_lane(base):
                     raise PredicateParseError(
                         "IN with string literals requires a string column"
                     )
-                if base.transform is not None:
+                if base.transform is not None or base.entries is not None:
                     truth = truth | _string_eq_lut(ds, base, item.value)
                 else:
                     code = _dict_lookup(ds, base.codes_of, item.value)
@@ -1300,19 +1537,19 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         return _Val(truth, valid, is_bool=True)
     if isinstance(node, Like):
         base = _eval(node.operand, batch, ds)
-        if base.codes_of is None:
+        if not _is_string_lane(base):
             raise PredicateParseError("LIKE requires a string column")
-        dictionary = ds.dictionary(base.codes_of)
+        view = _lane_entries(ds, base)
         pattern = (
             node.pattern if node.regex else _sql_like_to_regex(node.pattern)
         )
         prog = re.compile(pattern)
-        table = np.zeros(len(dictionary) + 1, dtype=bool)
-        for i, s in enumerate(dictionary):
-            if s is not None and prog.search(base.view(str(s))):
+        table = np.zeros(len(view) + 1, dtype=bool)
+        for i, s in enumerate(view):
+            if s is not None and prog.search(s):
                 table[i] = True
         lut = jnp.asarray(table)
-        truth = lut[jnp.clip(base.values, -1, len(dictionary) - 1)]
+        truth = lut[jnp.clip(base.values, -1, len(view) - 1)]
         truth = jnp.where(base.values < 0, False, truth)
         if node.negate:
             truth = ~truth
@@ -1324,12 +1561,21 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
         if node.name == "COALESCE":
             if not node.args:
                 raise PredicateParseError("COALESCE needs arguments")
-            parts = [_eval(a, batch, ds) for a in node.args]
-            if any(p.codes_of is not None for p in parts):
-                raise PredicateParseError(
-                    "COALESCE over string columns is not supported "
-                    "(numeric/boolean arguments only)"
-                )
+            branches = [
+                _eval_stringish(a, batch, ds) for a in node.args
+            ]
+            if _any_stringish(branches):
+                union, pairs = _string_union(ds, branches)
+                vals, valid = pairs[0]
+                for code, ok in pairs[1:]:
+                    vals = jnp.where(valid, vals, code)
+                    valid = valid | ok
+                return _Val(vals, valid, entries=tuple(union))
+            parts = [
+                b if isinstance(b, _Val)
+                else _Val(jnp.asarray(0.0), jnp.asarray(False))
+                for b in branches
+            ]
             vals = parts[0].values
             valid = parts[0].valid
             for p in parts[1:]:
@@ -1346,19 +1592,19 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             # LENGTH over a transformed string expression: per-code
             # i32 LUT of len(view(dict[i])), gathered by code
             v = _eval(arg, batch, ds)
-            if v.codes_of is None:
+            if not _is_string_lane(v):
                 raise PredicateParseError(
                     "LENGTH expects a string column or string function"
                 )
-            dictionary = ds.dictionary(v.codes_of)
-            table = np.zeros(len(dictionary) + 1, dtype=np.int32)
-            for i, s in enumerate(dictionary):
+            view = _lane_entries(ds, v)
+            table = np.zeros(len(view) + 1, dtype=np.int32)
+            for i, s in enumerate(view):
                 if s is not None:
-                    table[i] = len(v.view(str(s)))
+                    table[i] = len(s)
             lut = jnp.asarray(table)
-            idx = jnp.where(v.values < 0, len(dictionary), v.values)
+            idx = jnp.where(v.values < 0, len(view), v.values)
             return _Val(
-                lut[jnp.clip(idx, 0, len(dictionary))], v.valid
+                lut[jnp.clip(idx, 0, len(view))], v.valid
             )
         if node.name in ("DATE_ADD", "DATE_SUB"):
             v = _eval(node.args[0], batch, ds)
@@ -1403,41 +1649,90 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
             start_days, start_valid = days_of(node.args[1])
             return _Val(end_days - start_days, end_valid & start_valid)
         if node.name == "CONCAT":
-            # at most ONE column operand (checked at plan time):
-            # literals fold into the transform around it
-            col_val = None
-            parts = []
+            lanes: List[Tuple[str, object]] = []
             for a in node.args:
                 if isinstance(a, StringLit):
-                    parts.append(a.value)
+                    lanes.append(("lit", a.value))
                 else:
                     v = _eval(a, batch, ds)
-                    if v.codes_of is None:
+                    if not _is_string_lane(v):
                         raise PredicateParseError(
                             "CONCAT arguments must be strings"
                         )
-                    if col_val is not None:
-                        raise PredicateParseError(
-                            "CONCAT supports at most ONE column operand"
-                        )
-                    col_val = v
-                    parts.append(None)  # the column slot
-            if col_val is None:
+                    lanes.append(("lane", v))
+            n_lanes = sum(1 for k, _ in lanes if k == "lane")
+            if n_lanes == 0:
                 raise PredicateParseError(
                     "CONCAT of only literals is constant"
                 )
-            inner = col_val.view
-
-            def transform(s, _parts=tuple(parts), _inner=inner):
-                return "".join(
-                    _inner(s) if p is None else p for p in _parts
+            if n_lanes == 1 and all(
+                k == "lit" or v.codes_of is not None for k, v in lanes
+            ):
+                # one COLUMN-BACKED lane: literals fold into its lazy
+                # transform — no synthetic dictionary needed
+                col_val = next(v for k, v in lanes if k == "lane")
+                inner = col_val.view
+                parts = tuple(
+                    v if k == "lit" else None for k, v in lanes
                 )
 
+                def transform(s, _parts=parts, _inner=inner):
+                    return "".join(
+                        _inner(s) if p is None else p for p in _parts
+                    )
+
+                return _Val(
+                    col_val.values,
+                    col_val.valid,
+                    codes_of=col_val.codes_of,
+                    transform=transform,
+                )
+            # MULTI-column (or synthetic-lane) CONCAT: fold lanes into
+            # a cross-product synthetic dictionary (size bounded at
+            # plan time by _check_plan_budgets); row code = left_code
+            # * |right| + right_code; NULL if ANY operand is null
+            # (Spark's concat)
+            acc_entries: Optional[List[Optional[str]]] = None
+            acc_codes = None
+            acc_valid = None
+            pending = ""
+            for k, v in lanes:
+                if k == "lit":
+                    if acc_entries is None:
+                        pending += v
+                    else:
+                        acc_entries = [
+                            None if e is None else e + v
+                            for e in acc_entries
+                        ]
+                    continue
+                view = _lane_entries(ds, v)
+                L = len(view)
+                codes = jnp.clip(
+                    jnp.where(v.values < 0, 0, v.values), 0, L - 1
+                ).astype(jnp.int32)
+                if acc_entries is None:
+                    acc_entries = [
+                        None if e is None else pending + e
+                        for e in view
+                    ]
+                    pending = ""
+                    acc_codes = codes
+                    acc_valid = v.valid
+                else:
+                    acc_entries = [
+                        (
+                            None
+                            if ea is None or eb is None
+                            else ea + eb
+                        )
+                        for ea in acc_entries
+                        for eb in view
+                    ]
+                    acc_codes = acc_codes * jnp.int32(L) + codes
+                    acc_valid = acc_valid & v.valid
             return _Val(
-                col_val.values,
-                col_val.valid,
-                codes_of=col_val.codes_of,
-                transform=transform,
+                acc_codes, acc_valid, entries=tuple(acc_entries)
             )
         if node.name in _STRING_FNS:
             return _eval_string_fn(node, batch, ds)
@@ -1488,12 +1783,12 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 return _Val(
                     _CMP_FNS[node.op](lv, rv), base.valid, is_bool=True
                 )
-            if base.codes_of is None:
+            if not _is_string_lane(base):
                 raise PredicateParseError(
                     "string comparison requires a string column"
                 )
             if node.op in ("=", "!="):
-                if base.transform is not None:
+                if base.transform is not None or base.entries is not None:
                     truth = _string_eq_lut(ds, base, lit.value)
                 else:
                     code = _dict_lookup(ds, base.codes_of, lit.value)
@@ -1533,7 +1828,7 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                     lhs.ts_per_day // rhs.ts_per_day
                 )
         if node.op in _CMP:
-            if lhs.codes_of is not None and rhs.codes_of is not None:
+            if _is_string_lane(lhs) and _is_string_lane(rhs):
                 # two string columns: dictionary codes come from
                 # UNRELATED dictionaries (and even one dictionary is in
                 # order of appearance, not sorted) — remap both sides to
@@ -1542,13 +1837,13 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 lut_l, lut_r = _shared_rank_luts(ds, lhs, rhs)
                 lv = _gather_ranks(lut_l, lv)
                 rv = _gather_ranks(lut_r, rv)
-            elif (lhs.codes_of is None) != (rhs.codes_of is None):
+            elif _is_string_lane(lhs) != _is_string_lane(rhs):
                 raise PredicateParseError(
                     "cannot compare a string column with a non-string "
                     "operand (dictionary codes are not values)"
                 )
             return _Val(_CMP_FNS[node.op](lv, rv), valid, is_bool=True)
-        if lhs.codes_of is not None or rhs.codes_of is not None:
+        if _is_string_lane(lhs) or _is_string_lane(rhs):
             raise PredicateParseError(
                 f"arithmetic {node.op!r} is undefined for string columns"
             )
