@@ -1,0 +1,235 @@
+"""Cross-host high-cardinality grouping over loopback: the TPU-native
+shuffle spanning PROCESSES (docs/MULTIHOST.md steps 1-4; SURVEY §7
+hard part #1 extended across hosts).
+
+Two real processes (4 virtual CPU devices each) initialize
+``jax.distributed`` against a loopback coordinator and build ONE global
+8-device mesh. Each process reads ITS OWN parquet shard of a 10M-row,
+~10M-distinct int64 key column — no host ever sees the other's rows —
+and the bucketed ``all_to_all`` shuffle + per-shard sort + segment
+count (analyzers/spill.multihost_spill_frequencies) computes
+CountDistinct / Uniqueness / Distinctness / Entropy / Histogram with
+NO host-side Arrow fallback and no cross-host group-state merge: equal
+keys land on one device wherever their rows lived, and the count
+scalars psum into replicated values.
+
+The parent process then recomputes the same metrics over the WHOLE
+table with the device spill disabled (the host Arrow ground truth) and
+asserts equality.
+
+    python examples/multihost_grouping.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_ROWS = 10_000_000
+TOP_K = 12
+
+WORKER = r"""
+import json, sys
+import numpy as np
+coordinator, pid, shard_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+import os
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=2, process_id=int(pid)
+)
+from jax.sharding import Mesh
+
+from deequ_tpu import Dataset
+from deequ_tpu.analyzers.grouping import FrequencyPlan
+from deequ_tpu.analyzers.spill import multihost_spill_frequencies
+from deequ_tpu.analyzers import (
+    CountDistinct, Distinctness, Entropy, Histogram, Uniqueness,
+)
+
+dataset = Dataset.from_parquet(shard_path)
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+# count-family metrics share ONE shuffle (include_nulls=False);
+# Histogram keeps its null bin via a second plan — exactly the
+# single-host planner's split
+count_state = multihost_spill_frequencies(
+    dataset, FrequencyPlan(("k",), None, False), mesh
+)
+hist_state = multihost_spill_frequencies(
+    dataset, FrequencyPlan(("k",), None, True), mesh
+)
+
+out = {}
+for a in (CountDistinct("k"), Uniqueness("k"), Distinctness("k"),
+          Entropy("k")):
+    m = a.compute_metric_from_state(count_state)
+    assert m.value.is_success, (a, m.value)
+    out[a.name] = m.value.get()
+hist = Histogram("k", max_detail_bins=TOPK).compute_metric_from_state(
+    hist_state
+)
+assert hist.value.is_success, hist.value
+dist = hist.value.get()
+out["histogram"] = {
+    str(k): v.absolute for k, v in dist.values.items()
+}
+out["histogram_bins"] = dist.number_of_bins
+if int(pid) == 0:
+    print("METRICS " + json.dumps(out), flush=True)
+print(f"worker {pid} done", flush=True)
+""".replace("TOPK", str(TOP_K))
+
+
+def main() -> None:
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_mh_grouping_")
+    try:
+        _run(workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _run(workdir: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(8)
+    keys = rng.integers(0, 1 << 40, N_ROWS, dtype=np.int64).astype(object)
+    keys[::101] = None  # Histogram's null bin must survive the shuffle
+    # a few heavy hitters so the top-k histogram is deterministic
+    for rank, (value, count) in enumerate(
+        [(7, 90_000), (11, 70_000), (13, 50_000), (1 << 39, 30_000)]
+    ):
+        lo = 1000 + rank * 200_000
+        keys[lo : lo + count] = value
+    table = pa.table({"k": pa.array(list(keys), pa.int64())})
+
+    # UNEQUAL shards: 60% / 40%
+    split = int(N_ROWS * 0.6)
+    shards = []
+    for i, (off, length) in enumerate(
+        [(0, split), (split, N_ROWS - split)]
+    ):
+        path = os.path.join(workdir, f"shard{i}")
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(
+            table.slice(off, length),
+            os.path.join(path, "part0.parquet"),
+        )
+        shards.append(path)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, coordinator, str(i), shards[i]],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    import time as _time
+
+    deadline = _time.monotonic() + 600
+    outputs = [b"", b""]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outputs[i], _ = p.communicate(
+                    timeout=max(1.0, deadline - _time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                pass
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for i, p in enumerate(procs):
+            if p.poll() is None or not outputs[i]:
+                try:
+                    extra, _ = p.communicate(timeout=10)
+                    outputs[i] = outputs[i] + (extra or b"")
+                except Exception:  # noqa: BLE001 — reporting only
+                    pass
+    failed = [i for i, p in enumerate(procs) if p.returncode != 0]
+    if failed:
+        report = "\n".join(
+            f"--- worker {i} (rc={procs[i].returncode}) ---\n"
+            + outputs[i].decode(errors="replace")
+            for i in range(2)
+        )
+        raise RuntimeError(f"worker(s) {failed} failed:\n{report}")
+
+    got = None
+    for line in outputs[0].decode().splitlines():
+        if line.startswith("METRICS "):
+            got = json.loads(line[len("METRICS "):])
+    assert got is not None, outputs[0].decode()
+
+    # ground truth: whole table, device spill DISABLED (host Arrow)
+    from deequ_tpu import Dataset, config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        CountDistinct,
+        Distinctness,
+        Entropy,
+        Histogram,
+        Uniqueness,
+    )
+
+    whole = Dataset.from_arrow(table)
+    analyzers = [
+        CountDistinct("k"),
+        Uniqueness("k"),
+        Distinctness("k"),
+        Entropy("k"),
+        Histogram("k", max_detail_bins=TOP_K),
+    ]
+    with config.configure(device_spill_grouping=False):
+        ctx = AnalysisRunner.do_analysis_run(whole, analyzers)
+    for a in analyzers[:4]:
+        want = ctx.metric(a).value.get()
+        have = got[a.name]
+        assert abs(have - want) <= 1e-9 * max(1.0, abs(want)), (
+            a.name, have, want,
+        )
+        print(f"{a.name:>14}: multihost {have:.9g} == arrow {want:.9g}")
+    dist = ctx.metric(analyzers[4]).value.get()
+    want_hist = {str(k): v.absolute for k, v in dist.values.items()}
+    assert got["histogram_bins"] == dist.number_of_bins
+    # tie-breaking at the k-th bin may pick different equal-count
+    # keys; counts multiset and all common keys must agree exactly
+    assert sorted(got["histogram"].values()) == sorted(
+        want_hist.values()
+    ), (got["histogram"], want_hist)
+    for k in set(got["histogram"]) & set(want_hist):
+        assert got["histogram"][k] == want_hist[k], k
+    print(f"{'Histogram':>14}: multihost top-{TOP_K} == arrow")
+    print(
+        "multi-host grouping (2 processes, loopback, device shuffle): "
+        "metrics == whole-table Arrow"
+    )
+
+
+if __name__ == "__main__":
+    main()

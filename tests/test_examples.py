@@ -4,9 +4,10 @@ ours must RUN, so a signature drift in the public API fails loudly
 here instead of shipping silently).
 
 Each example's ``main()`` runs in-process on the suite's 8-virtual-
-device CPU backend (conftest).  ``multihost_profiling`` is excluded
-HERE only because ``tests/test_multihost.py`` already executes it as a
-two-real-process subprocess run — together the suite runs all 8."""
+device CPU backend (conftest).  ``multihost_profiling`` and
+``multihost_grouping`` are excluded HERE only because
+``tests/test_multihost.py`` already executes them as two-real-process
+subprocess runs — together the suite runs every example."""
 
 import importlib
 import os
@@ -40,8 +41,11 @@ def _all_examples() -> set:
 
 def test_every_example_is_covered():
     """A new example file must be added to _IN_PROCESS (or get its own
-    dedicated test like multihost_profiling has)."""
-    assert _all_examples() == set(_IN_PROCESS) | {"multihost_profiling"}
+    dedicated test like the multihost pair has)."""
+    assert _all_examples() == set(_IN_PROCESS) | {
+        "multihost_profiling",
+        "multihost_grouping",
+    }
 
 
 @pytest.mark.parametrize("name", _IN_PROCESS)

@@ -21,3 +21,23 @@ def test_two_process_loopback_merge_equals_whole_table():
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "merged == whole-table" in result.stdout
+
+
+def test_cross_host_grouping_shuffle_equals_whole_table():
+    """The cross-host high-cardinality grouping path (VERDICT r4 next
+    #3): two real processes, one global mesh, 10M rows with ~9.7M
+    distinct keys split 60/40 — CountDistinct/Uniqueness/Distinctness/
+    Entropy/Histogram through the bucketed all_to_all device shuffle
+    (NO Arrow fallback) must equal the whole-table host run. Delegates
+    to examples/multihost_grouping.py — the runnable demo IS the
+    test."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "examples", "multihost_grouping.py")
+    result = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "metrics == whole-table Arrow" in result.stdout

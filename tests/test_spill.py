@@ -533,3 +533,98 @@ class TestDenseDomainGate:
             )
         for a in (Uniqueness("q"), CountDistinct("q")):
             assert ctx.metric(a).value.get() == want.metric(a).value.get()
+
+
+class TestMeshedTwoLaneJoint:
+    def test_meshed_joint_exceeds_u64_equals_host(self, cpu_mesh):
+        """Joint key spaces past one u64 lane (> 2^62) under a MESH
+        (VERDICT r4 next #4): the hash-bucket all_to_all shuffle rides
+        TWO key lanes with a per-shard lax.sort(num_keys=2); the
+        count-family metrics must equal the host Arrow oracle exactly
+        (the sharded two-lane fetch/decode path is pinned directly by
+        test_meshed_two_lane_fetch_decodes_groups below — the only
+        pairwise analyzer, MutualInformation, can never reach a
+        > 2^62 joint)."""
+        from deequ_tpu.analyzers import spill as spill_mod
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(29)
+        n = 40_000
+        # five ~38k-cardinality columns: joint radix product ~8e22,
+        # well past one u64 lane
+        cols = {
+            f"c{j}": list(rng.integers(0, 500_000, n, dtype=np.int64))
+            for j in range(5)
+        }
+        ds = Dataset.from_pydict(cols)
+        names = list(cols)
+        sizes = [len(ds.dictionary(c)) + 1 for c in names]
+        joint = 1
+        for s in sizes:
+            joint *= s
+        assert joint >= 2**62  # genuinely needs the second lane
+        split = spill_mod.split_joint_lanes(tuple(sizes))
+        assert split is not None and split < len(names)
+
+        analyzers = [
+            CountDistinct(names),
+            Uniqueness(names),
+            Distinctness(names),
+            Entropy(names),
+        ]
+        engine = AnalysisEngine(mesh=cpu_mesh, batch_size=n)
+        with config.configure(dense_grouping_budget_bytes=4 * 1024):
+            with config.configure(device_spill_grouping=True):
+                ctx_mesh = AnalysisRunner.do_analysis_run(
+                    ds, analyzers, engine=engine
+                )
+            with config.configure(device_spill_grouping=False):
+                ctx_host = AnalysisRunner.do_analysis_run(ds, analyzers)
+        for z in analyzers:
+            d, h = ctx_mesh.metric(z).value, ctx_host.metric(z).value
+            assert d.is_success and h.is_success, (z, d, h)
+            assert d.get() == pytest.approx(h.get(), rel=1e-9), z
+
+    def test_meshed_two_lane_fetch_decodes_groups(self, cpu_mesh):
+        """The sharded two-lane fetch path (keys + counts across
+        shards) must reconstruct the exact group multiset."""
+        from deequ_tpu.analyzers.grouping import (
+            FrequencyPlan,
+            compute_many_frequencies,
+        )
+        from deequ_tpu.analyzers import spill as spill_mod
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(30)
+        n = 6_000
+        cols = {
+            f"c{j}": list(rng.integers(0, 400_000, n, dtype=np.int64))
+            for j in range(5)
+        }
+        ds = Dataset.from_pydict(cols)
+        names = tuple(cols)
+        sizes = [len(ds.dictionary(c)) + 1 for c in names]
+        joint = 1
+        for s in sizes:
+            joint *= s
+        assert joint >= 2**62
+        engine = AnalysisEngine(mesh=cpu_mesh, batch_size=n)
+        plan = FrequencyPlan(names, None, False)
+        with config.configure(
+            dense_grouping_budget_bytes=1024, device_spill_grouping=True
+        ):
+            dev = compute_many_frequencies(ds, [plan], engine=engine)[
+                plan
+            ]
+        assert isinstance(
+            dev, spill_mod.ShardedTwoLaneDeviceFrequencies
+        ), type(dev)
+        with config.configure(device_spill_grouping=False):
+            host = compute_many_frequencies(ds, [plan])[plan]
+        got = sorted(
+            (tuple(k), int(c)) for k, c in zip(dev.keys, dev.counts)
+        )
+        want = sorted(
+            (tuple(k), int(c)) for k, c in zip(host.keys, host.counts)
+        )
+        assert got == want
