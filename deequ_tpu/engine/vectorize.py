@@ -772,22 +772,74 @@ def plan_scan_units(
                 )
             elif key[0] == "hll":
                 pool = None
-                if key[1] == "values" and key[2] == "float32":
-                    candidate = kll_pools.get(key[3])
+                pooled_members, plain_members = members, []
+                candidate = kll_pools.get(key[3])
+                if (
+                    key[1] == "values"
+                    and candidate is not None
+                    and key[2] in ("float32", "int8", "int16", "int32")
+                ):
                     cols, _ = _index_members(members)
-                    if candidate is not None and set(cols) <= set(
-                        candidate
-                    ):
-                        pool = candidate
-                units.append(
-                    _build_hll_group(
-                        dataset,
-                        members,
-                        key[1],
-                        key[3],
-                        kll_pool_columns=pool,
+                    if set(cols) <= set(candidate):
+                        if key[2] == "float32":
+                            pool = candidate
+                        else:
+                            # integer storage rides the f32-cast pool
+                            # only when the column's RANGE both fits
+                            # the 24-bit mantissa (cast exact; dict
+                            # entries cast back before the integral
+                            # hash — sketches/hll.py) and BOUNDS the
+                            # cardinality near the dict cap, so
+                            # guaranteed-high-card key columns keep
+                            # the one stacked scatter instead of
+                            # per-column probes
+                            lim = 4 * hll.DEDUP_DICT_CAP
+                            exact = 1 << 24  # f32 mantissa
+
+                            def bounded(c):
+                                # BOTH conditions: narrow range (so
+                                # cardinality is bounded near the dict
+                                # cap) AND magnitude within the f32
+                                # mantissa (a narrow range at 2^30
+                                # still casts inexactly — review
+                                # finding)
+                                r = dataset.integral_range(c)
+                                return (
+                                    r is not None
+                                    and (r[1] - r[0]) < lim
+                                    and -exact <= r[0]
+                                    and r[1] <= exact
+                                )
+
+                            pooled_members = [
+                                a for a in members if bounded(a.column)
+                            ]
+                            plain_members = [
+                                a
+                                for a in members
+                                if not bounded(a.column)
+                            ]
+                            if pooled_members:
+                                pool = candidate
+                            else:
+                                pooled_members = members
+                                plain_members = []
+                if plain_members:
+                    units.append(
+                        _build_hll_group(
+                            dataset, plain_members, key[1], key[3]
+                        )
                     )
-                )
+                if pooled_members:
+                    units.append(
+                        _build_hll_group(
+                            dataset,
+                            pooled_members,
+                            key[1],
+                            key[3],
+                            kll_pool_columns=pool,
+                        )
+                    )
             elif key[0] == "kll":
                 units.append(
                     _build_kll_group(dataset, members, key[3])

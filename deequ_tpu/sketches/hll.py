@@ -332,7 +332,14 @@ def dedup_column_registers_from_sorted(
     The three non-finite values (+inf, -inf, NaN) are therefore absent
     from the unique run and re-enter as flagged extra dictionary
     slots, probed from the raw column. Bit-identity caveats match
-    dedup_column_registers (canonical-NaN collapse)."""
+    dedup_column_registers (canonical-NaN collapse).
+
+    INTEGER columns may ride the same f32 pool when the planner has
+    proven their range fits the 24-bit mantissa (f32 cast exact):
+    dictionary entries cast BACK to the raw dtype before hashing, so
+    they take hash_pair_numeric's integral path bit-identically to the
+    per-row scatter; the non-finite extras are impossible for int data
+    (their flags are always False) and their cast garbage is masked."""
     (B,) = s.shape
     D = min(DEDUP_DICT_CAP, B)
     sentval = jnp.asarray(jnp.inf, s.dtype)
@@ -341,9 +348,14 @@ def dedup_column_registers_from_sorted(
     )
     real_u = uniq & (s < sentval)
     U = jnp.sum(real_u).astype(jnp.int32)
-    pos_inf = jnp.any((xc == jnp.inf) & maskc)
-    neg_inf = jnp.any((xc == -jnp.inf) & maskc)
-    nan_flag = jnp.any(jnp.isnan(xc) & maskc)
+    integral = not jnp.issubdtype(xc.dtype, jnp.floating)
+    if integral:
+        false = jnp.asarray(False)
+        pos_inf = neg_inf = nan_flag = false
+    else:
+        pos_inf = jnp.any((xc == jnp.inf) & maskc)
+        neg_inf = jnp.any((xc == -jnp.inf) & maskc)
+        nan_flag = jnp.any(jnp.isnan(xc) & maskc)
 
     def dict_path():
         targets = jnp.arange(1, D + 1, dtype=jnp.int32)
@@ -352,7 +364,7 @@ def dedup_column_registers_from_sorted(
         pos = jnp.searchsorted(ranks, targets)
         entries = s[jnp.clip(pos, 0, B - 1)]
         extras = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], s.dtype)
-        full = jnp.concatenate([entries, extras])
+        full = jnp.concatenate([entries, extras]).astype(xc.dtype)
         valid = jnp.concatenate(
             [slot < U, jnp.stack([pos_inf, neg_inf, nan_flag])]
         )

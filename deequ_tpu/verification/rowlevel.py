@@ -9,7 +9,9 @@ Supported families:
 - **mask/predicate**: Completeness, Compliance (and every Check method
   that compiles to it: is_contained_in, is_non_negative, satisfies,
   ...), PatternMatch (and contains_email/url/...);
-- **grouping**: Uniqueness (a row passes iff its key occurs once);
+- **grouping**: Uniqueness and UniqueValueRatio (a row passes iff
+  its key occurs once — the reference's RowLevelGroupedConstraint
+  rule for both);
 - **asserted-value** (r4, reference's RowLevelAssertedConstraint):
   MinLength/MaxLength (per-row string length) and Minimum/Maximum
   (per-row numeric value) apply the CONSTRAINT'S OWN assertion to each
@@ -46,7 +48,7 @@ from deequ_tpu.analyzers.basic import (
     MinLength,
     PatternMatch,
 )
-from deequ_tpu.analyzers.grouping import Uniqueness
+from deequ_tpu.analyzers.grouping import Uniqueness, UniqueValueRatio
 from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
 from deequ_tpu.constraints.constraint import (
     AnalysisBasedConstraint,
@@ -158,7 +160,7 @@ def _outcome_for(
         out = lut[np.clip(idx, 0, len(lut) - 1)] & np.asarray(
             mask, dtype=bool
         )
-    elif isinstance(analyzer, Uniqueness):
+    elif isinstance(analyzer, (Uniqueness, UniqueValueRatio)):
         columns = analyzer.grouping_columns()
         # fold columns into one exact group id via successive np.unique
         # in each column's NATIVE dtype — no float64 cast (int64 ids

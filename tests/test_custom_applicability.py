@@ -152,6 +152,19 @@ class TestRowLevelResults:
         email = next(v for k, v in by_name.items() if "email" in k.lower())
         assert email == [True, False, True, False]
 
+    def test_unique_value_ratio_row_level(self):
+        """UniqueValueRatio marks exactly the rows whose key occurs
+        once — the reference's RowLevelGroupedConstraint rule, same as
+        Uniqueness (r5)."""
+        ds = Dataset.from_pydict({"id": [1, 2, 2, 3, 3, 4]})
+        check = Check(CheckLevel.ERROR, "uvr").has_unique_value_ratio(
+            ["id"], lambda v: v >= 0.5
+        )
+        result = VerificationSuite().on_data(ds).add_check(check).run()
+        rl = result.row_level_results_as_dataset().table
+        col = rl.column(rl.schema.names[0]).to_pylist()
+        assert col == [True, False, False, False, False, True]
+
     def test_where_filtered_rows_pass(self):
         ds = Dataset.from_pydict({"x": [1.0, -5.0, 2.0], "g": [1, 2, 1]})
         check = (

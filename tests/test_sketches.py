@@ -595,3 +595,110 @@ class TestDedupFromSortedPool:
             a = together.metric(ApproxCountDistinct(col)).value.get()
             b = alone.metric(ApproxCountDistinct(col)).value.get()
             assert a == b, (col, a, b)
+
+
+class TestIntPoolDedup:
+    """Range-gated integer columns ride the f32 KLL pool (r5): the
+    dictionary entries cast back to the raw dtype before hashing, so
+    registers stay bit-identical to the per-row integral scatter."""
+
+    def test_int_pool_variant_matches_scatter(self):
+        from deequ_tpu.sketches import hll
+
+        rng = np.random.default_rng(51)
+        B = 8192
+        for vals in (
+            rng.integers(1, 101, B).astype(np.int32),  # quantity-like
+            rng.integers(-(1 << 24), 1 << 24, B).astype(
+                np.int32
+            ),  # full f32-exact range
+        ):
+            maskc = rng.random(B) > 0.1
+            s = np.sort(
+                np.where(maskc, vals.astype(np.float32), np.float32(np.inf))
+            )
+            got = np.asarray(
+                hll.dedup_column_registers_from_sorted(
+                    jnp.asarray(s),
+                    jnp.asarray(vals),
+                    jnp.asarray(maskc),
+                )
+            )
+            h1, h2 = hll.hash_pair_numeric(jnp.asarray(vals))
+            want = np.asarray(
+                hll.registers_from_hash_pair(h1, h2, jnp.asarray(maskc))
+            )
+            np.testing.assert_array_equal(got, want)
+
+    def test_end_to_end_int_pool_equality(self):
+        """Quantity-style int column profiled WITH quantiles (pool
+        fires) must report the same ApproxCountDistinct as alone."""
+        from deequ_tpu.analyzers import (
+            AnalysisRunner,
+            ApproxCountDistinct,
+            ApproxQuantiles,
+        )
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(52)
+        n = 30_000
+        ds = Dataset.from_pydict(
+            {
+                "qty": rng.integers(1, 101, n),
+                "k": rng.integers(0, 1 << 22, n),
+            }
+        )
+        together = AnalysisRunner.do_analysis_run(
+            ds,
+            [
+                ApproxCountDistinct("qty"),
+                ApproxCountDistinct("k"),
+                ApproxQuantiles("qty", [0.5]),
+                ApproxQuantiles("k", [0.5]),
+            ],
+        )
+        for col in ("qty", "k"):
+            alone = AnalysisRunner.do_analysis_run(
+                ds, [ApproxCountDistinct(col)]
+            )
+            a = together.metric(ApproxCountDistinct(col)).value.get()
+            b = alone.metric(ApproxCountDistinct(col)).value.get()
+            assert a == b, (col, a, b)
+        assert together.metric(
+            ApproxCountDistinct("qty")
+        ).value.get() == pytest.approx(100, abs=2)
+
+    def test_high_magnitude_narrow_range_not_pooled(self):
+        """A narrow-RANGE int32 column at high magnitude (~2^30) must
+        NOT ride the f32 pool (the cast is inexact there): its
+        estimate must match the analyzer run alone (review finding)."""
+        from deequ_tpu.analyzers import (
+            AnalysisRunner,
+            ApproxCountDistinct,
+            ApproxQuantiles,
+        )
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(53)
+        n = 20_000
+        base = 1 << 30
+        vals = base + rng.integers(0, 77, n)  # width 77, magnitude 2^30
+        ds = Dataset.from_pydict(
+            {"a": vals, "b": rng.integers(1, 50, n)}
+        )
+        together = AnalysisRunner.do_analysis_run(
+            ds,
+            [
+                ApproxCountDistinct("a"),
+                ApproxCountDistinct("b"),
+                ApproxQuantiles("a", [0.5]),
+                ApproxQuantiles("b", [0.5]),
+            ],
+        )
+        alone = AnalysisRunner.do_analysis_run(
+            ds, [ApproxCountDistinct("a")]
+        )
+        a = together.metric(ApproxCountDistinct("a")).value.get()
+        b = alone.metric(ApproxCountDistinct("a")).value.get()
+        assert a == b, (a, b)
+        assert a == pytest.approx(77, abs=2)
