@@ -779,59 +779,66 @@ def plan_scan_units(
                     and candidate is not None
                     and key[2] in ("float32", "int8", "int16", "int32")
                 ):
-                    cols, _ = _index_members(members)
-                    if set(cols) <= set(candidate):
-                        if key[2] == "float32":
+                    if key[2] == "float32":
+                        cols, _ = _index_members(members)
+                        if set(cols) <= set(candidate):
                             pool = candidate
-                        else:
-                            # integer storage rides the f32-cast pool
-                            # only when the column's RANGE both fits
-                            # the 24-bit mantissa (cast exact; dict
-                            # entries cast back before the integral
-                            # hash — sketches/hll.py) and BOUNDS the
-                            # cardinality near the dict cap, so
-                            # guaranteed-high-card key columns keep
-                            # the one stacked scatter instead of
-                            # per-column probes
-                            lim = 4 * hll.DEDUP_DICT_CAP
-                            exact = 1 << 24  # f32 mantissa
+                    else:
+                        # integer storage rides the f32-cast pool only
+                        # when the column's RANGE both fits the 24-bit
+                        # mantissa (cast exact; dict entries cast back
+                        # before the integral hash — sketches/hll.py)
+                        # and BOUNDS the cardinality near the dict
+                        # cap, so guaranteed-high-card key columns
+                        # keep the one stacked scatter instead of
+                        # per-column probes. Coverage is judged per
+                        # POOLED column (an unbounded group-mate must
+                        # not veto its bounded neighbors).
+                        lim = 4 * hll.DEDUP_DICT_CAP
+                        exact = 1 << 24  # f32 mantissa
+                        cand_set = set(candidate)
 
-                            def bounded(c):
-                                # BOTH conditions: narrow range (so
-                                # cardinality is bounded near the dict
-                                # cap) AND magnitude within the f32
-                                # mantissa (a narrow range at 2^30
-                                # still casts inexactly — review
-                                # finding)
-                                r = dataset.integral_range(c)
-                                return (
-                                    r is not None
-                                    and (r[1] - r[0]) < lim
-                                    and -exact <= r[0]
-                                    and r[1] <= exact
-                                )
+                        def poolable(c):
+                            r = dataset.integral_range(c)
+                            return (
+                                c in cand_set
+                                and r is not None
+                                and (r[1] - r[0]) < lim
+                                and -exact <= r[0]
+                                and r[1] <= exact
+                            )
 
+                        pooled_idx = {
+                            i
+                            for i, a in enumerate(members)
+                            if poolable(a.column)
+                        }
+                        if pooled_idx:
+                            pool = candidate
                             pooled_members = [
-                                a for a in members if bounded(a.column)
+                                a
+                                for i, a in enumerate(members)
+                                if i in pooled_idx
                             ]
                             plain_members = [
                                 a
-                                for a in members
-                                if not bounded(a.column)
+                                for i, a in enumerate(members)
+                                if i not in pooled_idx
                             ]
-                            if pooled_members:
-                                pool = candidate
-                            else:
-                                pooled_members = members
-                                plain_members = []
+                # build EVERY unit before appending ANY: a failure
+                # mid-way would otherwise leave the already-appended
+                # half ALSO planned as singles by the except below —
+                # the same analyzer computed twice per batch (review
+                # finding)
+                new_units = []
                 if plain_members:
-                    units.append(
+                    new_units.append(
                         _build_hll_group(
                             dataset, plain_members, key[1], key[3]
                         )
                     )
                 if pooled_members:
-                    units.append(
+                    new_units.append(
                         _build_hll_group(
                             dataset,
                             pooled_members,
@@ -840,6 +847,7 @@ def plan_scan_units(
                             kll_pool_columns=pool,
                         )
                     )
+                units.extend(new_units)
             elif key[0] == "kll":
                 units.append(
                     _build_kll_group(dataset, members, key[3])

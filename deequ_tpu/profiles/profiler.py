@@ -144,7 +144,8 @@ class ColumnProfiler:
         # approx-distinct test, so which histograms ship is unchanged.
         pass1_histograms: List[str] = []
         for c in columns:
-            if data.schema.kind_of(c) in (Kind.STRING, Kind.BOOLEAN):
+            kind_c = data.schema.kind_of(c)
+            if kind_c in (Kind.STRING, Kind.BOOLEAN):
                 try:
                     size = data.dictionary_size_within(
                         c, low_cardinality_histogram_threshold
@@ -152,6 +153,21 @@ class ColumnProfiler:
                 except Exception:  # noqa: BLE001 — odd column: pass 3
                     size = None
                 if size is not None:
+                    pass1_histograms.append(c)
+            elif kind_c == Kind.INTEGRAL:
+                # r5: a bounded VALUE RANGE (one O(1) min/max probe,
+                # free from parquet statistics) bounds the distinct
+                # count, so quantity-style integer histograms ride
+                # pass 1's fused scan too — a streamed 1B-row profile
+                # then reads its source once less (pass 3 previously
+                # re-scanned for exactly these columns)
+                try:
+                    rng_c = data.integral_range(c)
+                except Exception:  # noqa: BLE001 — odd column: pass 3
+                    rng_c = None
+                if rng_c is not None and (
+                    rng_c[1] - rng_c[0]
+                ) < low_cardinality_histogram_threshold:
                     pass1_histograms.append(c)
         for c in columns:
             pass1.append(Completeness(c))

@@ -184,9 +184,17 @@ def _outcome_for(
                 _, group_ids = np.unique(
                     pair, axis=0, return_inverse=True
                 )
-        _, inverse, counts = np.unique(
-            group_ids, return_inverse=True, return_counts=True
-        )
+        _, inverse = np.unique(group_ids, return_inverse=True)
+        if excluded is not None:
+            # occurrence counts over the FILTERED data only: a key
+            # unique within the filter passes even if where-excluded
+            # rows share it (their own outcome is overridden by
+            # filtered_row_outcome) — review finding r5
+            counts = np.bincount(
+                inverse[~excluded], minlength=inverse.max() + 1
+            )
+        else:
+            counts = np.bincount(inverse)
         out = counts[inverse] == 1
     else:
         return None

@@ -152,6 +152,24 @@ class TestRowLevelResults:
         email = next(v for k, v in by_name.items() if "email" in k.lower())
         assert email == [True, False, True, False]
 
+    def test_uniqueness_row_level_respects_where(self):
+        """Occurrence counts for row-level Uniqueness/UniqueValueRatio
+        run over the FILTERED data: a key unique within the filter
+        passes even when a where-excluded row shares it (r5 review
+        finding)."""
+        ds = Dataset.from_pydict({"id": [1, 1, 2], "g": [1, 2, 1]})
+        check = (
+            Check(CheckLevel.ERROR, "w")
+            .has_unique_value_ratio(["id"], lambda v: v == 1.0)
+            .where("g = 1")
+        )
+        result = VerificationSuite().on_data(ds).add_check(check).run()
+        rl = result.row_level_results_as_dataset().table
+        col = rl.column(rl.schema.names[0]).to_pylist()
+        # row 0: only id=1 INSIDE the filter -> unique -> passes;
+        # row 1: excluded -> passes by default; row 2: unique
+        assert col == [True, True, True]
+
     def test_unique_value_ratio_row_level(self):
         """UniqueValueRatio marks exactly the rows whose key occurs
         once — the reference's RowLevelGroupedConstraint rule, same as

@@ -120,13 +120,28 @@ class TestRunMetadata:
         names = [p.name for p in meta.passes]
         assert names == ["scan"]
 
-        # an INTEGER low-cardinality column still needs the separate
-        # histogram pass (its cardinality is only known after pass 1)
+        # r5: a bounded-RANGE integer column's histogram ALSO rides
+        # pass 1 (the O(1) min/max probe bounds its cardinality), so
+        # the whole profile stays one fused scan
         ds2 = Dataset.from_pydict(
             {"x": list(np.arange(500.0)), "k": [1, 2, 3, 4] * 125}
         )
-        meta2 = ColumnProfiler.profile(ds2).run_metadata
-        assert [p.name for p in meta2.passes] == ["scan", "scan"]
+        profiles2 = ColumnProfiler.profile(ds2)
+        meta2 = profiles2.run_metadata
+        assert [p.name for p in meta2.passes] == ["scan"]
+        assert len(profiles2.profiles["k"].histogram.values) == 4
+        # a WIDE-range integer that turns out low-cardinality still
+        # takes the separate histogram pass (cardinality only known
+        # after pass 1)
+        ds3 = Dataset.from_pydict(
+            {"x": list(np.arange(500.0)),
+             "k": [1, 1 << 30, 3, 4] * 125}
+        )
+        profiles3 = ColumnProfiler.profile(ds3)
+        assert [p.name for p in profiles3.run_metadata.passes] == [
+            "scan", "scan",
+        ]
+        assert len(profiles3.profiles["k"].histogram.values) == 4
 
 
 class TestPlanCache:
