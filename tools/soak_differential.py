@@ -6,7 +6,8 @@ suite (minutes of wall time); run manually before a release:
 
     python tools/soak_differential.py
 
-Last run (round 4): 0 failures over 200 seeds.
+Last run (round 5): 0 failures over 200 seeds (post sorted-dedup HLL,
+dense-domain grouping, and predicate-grammar extensions).
 """
 
 import sys, traceback
