@@ -1700,18 +1700,16 @@ def multihost_spill_frequencies(
     host ever re-merges. The 10M-group state never crosses hosts;
     Histogram fetches only per-shard top-k candidates.
 
-    v1 scope: single column, no ``where`` predicate (the multi-host
-    deployment shards BY ROW before planning; a where-filter belongs in
-    each host's own scan). Raises SpillOverflow exactly like the
-    single-host path when a hash bucket exceeds its static capacity."""
+    ``where`` predicates evaluate PER ROW on each host's own shard
+    (compiled against that shard's dictionaries) before the key build,
+    so any supported predicate works — the shuffle only ever sees the
+    surviving keys. Scope: single grouping column. Raises
+    SpillOverflow exactly like the single-host path when a hash bucket
+    exceeds its static capacity."""
     import jax
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if plan.where is not None:
-        raise ValueError(
-            "multihost_spill_frequencies v1 supports no where-filter"
-        )
     column = plan.columns[0]
     values_dtype = dataset.request_dtype(ColumnRequest(column, "values"))
     if values_dtype.kind != "f":
@@ -1721,6 +1719,36 @@ def multihost_spill_frequencies(
     else:
         key_kind = "f32"
     host_bits = key_kind == "f64" and jax.default_backend() != "cpu"
+
+    pred = None
+    pred_error: Optional[BaseException] = None
+    if plan.where is not None:
+        from deequ_tpu.sql.predicate import compile_predicate
+
+        # compile BEFORE any collective — and make the outcome
+        # UNIFORM: plan-budget checks depend on each shard's own
+        # dictionaries, so one host can fail where another succeeds;
+        # raising on only one host would strand its peers in the next
+        # allgather forever (review finding). The first collective is
+        # therefore a success-flag exchange every host participates in.
+        try:
+            pred = compile_predicate(plan.where, dataset)
+        except Exception as exc:  # noqa: BLE001 — exchanged below
+            pred_error = exc
+    ok_flags = np.asarray(
+        multihost_utils.process_allgather(
+            jax.numpy.asarray(
+                [0 if pred_error is not None else 1],
+                dtype=jax.numpy.int32,
+            )
+        )
+    ).reshape(-1)
+    if not ok_flags.all():
+        bad = [int(i) for i in np.nonzero(ok_flags == 0)[0]]
+        raise ValueError(
+            f"where-predicate compilation failed on host(s) {bad}"
+            + (f": {pred_error!r}" if pred_error is not None else "")
+        )
 
     ndev = mesh.shape[axis]
     local_devices = [
@@ -1754,6 +1782,19 @@ def multihost_spill_frequencies(
     mask = pad_to(dataset.materialize(ColumnRequest(column, "mask")))
     rows = np.zeros(padded_local, dtype=bool)
     rows[:n_local] = True
+    if pred is not None:
+        batch = {
+            r.key: pad_to(
+                np.asarray(dataset.materialize(r))
+            )
+            for r in pred.requests
+        }
+        # one-shot eager eval (like the host_f64 key path): a fresh
+        # jit wrapper here would recompile per call (review finding)
+        complies = np.asarray(
+            jax.device_get(pred.complies(batch)), dtype=bool
+        )
+        rows = rows & complies
 
     if host_bits:
         bits = pad_to(f64_canonical_bits(values[:n_local]))

@@ -70,6 +70,12 @@ count_state = multihost_spill_frequencies(
 hist_state = multihost_spill_frequencies(
     dataset, FrequencyPlan(("k",), None, True), mesh
 )
+# where-filters evaluate per row on each host's OWN shard before the
+# shuffle (r5): the filtered count must equal the whole-table filtered
+# run too
+where_state = multihost_spill_frequencies(
+    dataset, FrequencyPlan(("k",), "k % 2 = 0", False), mesh
+)
 
 out = {}
 for a in (CountDistinct("k"), Uniqueness("k"), Distinctness("k"),
@@ -77,6 +83,11 @@ for a in (CountDistinct("k"), Uniqueness("k"), Distinctness("k"),
     m = a.compute_metric_from_state(count_state)
     assert m.value.is_success, (a, m.value)
     out[a.name] = m.value.get()
+m = CountDistinct("k", where="k % 2 = 0").compute_metric_from_state(
+    where_state
+)
+assert m.value.is_success, m.value
+out["CountDistinct_where"] = m.value.get()
 hist = Histogram("k", max_detail_bins=TOPK).compute_metric_from_state(
     hist_state
 )
@@ -207,6 +218,17 @@ def _run(workdir: str) -> None:
     ]
     with config.configure(device_spill_grouping=False):
         ctx = AnalysisRunner.do_analysis_run(whole, analyzers)
+    filtered = CountDistinct("k", where="k % 2 = 0")
+    with config.configure(device_spill_grouping=False):
+        ctx_w = AnalysisRunner.do_analysis_run(whole, [filtered])
+    want_w = ctx_w.metric(filtered).value.get()
+    assert abs(got["CountDistinct_where"] - want_w) <= 1e-9 * max(
+        1.0, abs(want_w)
+    ), (got["CountDistinct_where"], want_w)
+    print(
+        f"{'CountDistinct/where':>14}: multihost "
+        f"{got['CountDistinct_where']:.9g} == arrow {want_w:.9g}"
+    )
     for a in analyzers[:4]:
         want = ctx.metric(a).value.get()
         have = got[a.name]
