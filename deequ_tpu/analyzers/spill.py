@@ -211,6 +211,15 @@ def f64_canonical_bits(values: np.ndarray) -> np.ndarray:
     return bits
 
 
+@functools.lru_cache(maxsize=None)
+def _finish_keys_jit(include_nulls: bool):
+    """Cached jitted _finish_keys wrapper (a fresh per-call lambda
+    would defeat jit's cache and recompile every invocation)."""
+    return jax.jit(
+        lambda b, m, r: _finish_keys(b, m, r, include_nulls)
+    )
+
+
 def host_f64_u64_keys(
     values: np.ndarray, mask: np.ndarray, rows: np.ndarray,
     include_nulls: bool,
@@ -560,7 +569,9 @@ def _sharded_spill2_fn(mesh, axis: str, cap: int):
 
     ndev = mesh.shape[axis]
 
-    def per_shard(k1, k2, n_sentinel_global):
+    def per_shard(k1, k2):
+        # no sentinel scalar: joint codes can never reach the
+        # sentinel, so there is no analytic max-group to reconstruct
         is_sent = k1 == _SENTINEL
         bucket = (
             _fmix64(k1 ^ _fmix64(k2)) % np.uint64(ndev)
@@ -587,7 +598,7 @@ def _sharded_spill2_fn(mesh, axis: str, cap: int):
     sharded = shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis)),
         out_specs=(P(), P(axis), P(axis), P(axis), P(axis), P()),
         check_vma=False,
     )
@@ -1185,8 +1196,8 @@ def _sharded_shuffle2(dataset, engine, needed, build, label: str):
     flat, mesh, axis, ndev, cap = _stage_mesh_columns(
         dataset, engine, needed
     )
-    k1, k2, n_sentinel = jax.jit(build)(flat)
-    out = _sharded_spill2_fn(mesh, axis, cap)(k1, k2, n_sentinel)
+    k1, k2, _ = jax.jit(build)(flat)
+    out = _sharded_spill2_fn(mesh, axis, cap)(k1, k2)
     scalars, g_hi, g_lo, g_counts, g_segs, overflow = out
     scalars, overflow_host, segs_host = packed_device_get(
         (scalars, overflow, np.asarray(g_segs))
@@ -1580,7 +1591,15 @@ class MultihostDeviceFrequencies(ShardedDeviceFrequencies):
     metrics read the replicated psum scalars (fetchable on every
     host); Histogram's top-k merges per-shard candidates gathered
     across processes; the full (keys, counts) union is gathered only
-    if something actually reads ``.keys``/``.counts`` (persistence)."""
+    if something actually reads ``.keys``/``.counts`` (persistence).
+
+    COLLECTIVE CONTRACT: ``top_groups`` / ``.keys`` / ``.counts``
+    issue ``process_allgather`` collectives lazily — EVERY process
+    must reach them together (SPMD), exactly like the call that built
+    this state. Reading them from one process only (e.g. inside an
+    ``if process_index() == 0:`` block) strands the peers in the
+    collective. The scalar count metrics (CountDistinct/Uniqueness/
+    Distinctness/Entropy) are replicated and safe to read anywhere."""
 
     def _local_live_pairs(self):
         """(keys, counts) concatenated over THIS process's shards."""
@@ -1798,8 +1817,8 @@ def multihost_spill_frequencies(
 
     if host_bits:
         bits = pad_to(f64_canonical_bits(values[:n_local]))
-        keys_local, n_sent_l, n_null_l = jax.jit(
-            lambda b, m, r: _finish_keys(b, m, r, plan.include_nulls)
+        keys_local, n_sent_l, n_null_l = _finish_keys_jit(
+            plan.include_nulls
         )(bits, mask, rows)
     else:
         keys_local, n_sent_l, n_null_l = _chunk_key_fn(

@@ -1448,10 +1448,15 @@ def _eval(node: Node, batch: Dict[str, jnp.ndarray], ds: Dataset) -> _Val:
                 # a string with no finite numeric value has no
                 # integral parse -> NULL (Spark's string-to-int cast
                 # rejects 'NaN'/'Infinity'; review finding on the r4
-                # validity-table fix)
+                # validity-table fix); finite parses saturate at the
+                # target bounds like the numeric-source path — one
+                # consistent JVM-d2i cast model (review finding r5)
                 finite = jnp.isfinite(vals)
                 valid = valid & finite
-                vals = jnp.trunc(jnp.where(finite, vals, 0.0))
+                lo, hi = _INT_CAST_BOUNDS[node.type_name]
+                vals = jnp.clip(
+                    jnp.trunc(jnp.where(finite, vals, 0.0)), lo, hi
+                )
             return _Val(vals, valid)
         vals = v.values.astype(jnp.float64)
         valid = v.valid

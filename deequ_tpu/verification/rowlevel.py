@@ -185,6 +185,8 @@ def _outcome_for(
                     pair, axis=0, return_inverse=True
                 )
         _, inverse = np.unique(group_ids, return_inverse=True)
+        if inverse.size == 0:
+            return np.zeros(0, dtype=bool)
         if excluded is not None:
             # occurrence counts over the FILTERED data only: a key
             # unique within the filter passes even if where-excluded

@@ -151,7 +151,8 @@ def _ev(node, row):
             if integral:
                 if not np.isfinite(f):
                     return _NULL
-                return float(np.trunc(f))
+                lo, hi = _INT_CAST_BOUNDS[node.type_name]
+                return float(np.clip(np.trunc(f), lo, hi))
             return f
         f = float(v)
         if integral:
@@ -281,7 +282,7 @@ def oracle_compliance(expression: str, rows) -> float:
 # random generator
 # --------------------------------------------------------------------------
 
-_STR_POOL = ["aa", "b", "1.5", "Zq", "", "  pad  ", "NaN", "7", "x_y"]
+_STR_POOL = ["aa", "b", "1.5", "Zq", "", "  pad  ", "NaN", "7", "x_y", "3000000000"]
 
 
 def make_soak_dataset(rng, n: int = 200):
