@@ -641,3 +641,48 @@ class TestPredicateSoakSmoke:
         # the generator emits only supported grammar: any plan-time
         # rejection means generator and compiler disagree on coverage
         assert skipped == 0
+
+
+class TestR5GrammarIntegration:
+    """The r5 grammar flows through the OTHER predicate consumers:
+    row-level outcomes and Applicability."""
+
+    def test_row_level_with_synthetic_lanes(self):
+        from deequ_tpu import Check, CheckLevel, VerificationSuite
+
+        ds = Dataset.from_pydict(
+            {
+                "a": ["x", None, "y"],
+                "b": ["1", "2", None],
+                "n": [1.0, 2.0, 3.0],
+            }
+        )
+        check = Check(CheckLevel.ERROR, "rl").satisfies(
+            "CONCAT(a, '-', b) = 'x-1' OR "
+            "CASE WHEN n > 2 THEN a ELSE b END = 'y'",
+            "syn",
+            lambda v: v > 0,
+        )
+        result = VerificationSuite().on_data(ds).add_check(check).run()
+        rl = result.row_level_results_as_dataset().table
+        col = rl.column(rl.schema.names[0]).to_pylist()
+        # row0: concat 'x-1' T; row1: a null->concat NULL, case n<=2
+        #   -> b='2' != 'y' F; row2: concat NULL, case n>2 -> a='y' T
+        assert col == [True, False, True]
+
+    def test_applicability_with_r5_grammar(self):
+        from deequ_tpu import Check, CheckLevel
+        from deequ_tpu.analyzers.applicability import Applicability
+
+        ds = Dataset.from_pydict({"s": ["a"], "t": ["b"], "n": [1.0]})
+        check = (
+            Check(CheckLevel.ERROR, "app")
+            .satisfies("CONCAT(s, t) != ''", "c1", lambda v: v >= 0)
+            .satisfies(
+                "COALESCE(s, 'z') = 'a' AND CAST(s AS STRING) <= 'b'",
+                "c2",
+                lambda v: v >= 0,
+            )
+        )
+        report = Applicability().is_applicable(check, ds.schema)
+        assert report.is_applicable, report
