@@ -702,3 +702,84 @@ class TestIntPoolDedup:
         b = alone.metric(ApproxCountDistinct("a")).value.get()
         assert a == b, (a, b)
         assert a == pytest.approx(77, abs=2)
+
+
+class TestPooledPathEdges:
+    """Degenerate shapes through the r5 pooled/dedup machinery: empty
+    tables, all-null pooled columns, single rows, and where-filtered
+    HLL co-planned with an unfiltered KLL group (different where ->
+    no pool, adaptive path)."""
+
+    def test_empty_dataset_profiles(self):
+        import pyarrow as pa
+
+        from deequ_tpu import ColumnProfilerRunner, Dataset
+
+        ds = Dataset.from_arrow(
+            pa.table(
+                {
+                    "x": pa.array([], pa.float32()),
+                    "q": pa.array([], pa.int64()),
+                }
+            )
+        )
+        p = ColumnProfilerRunner().on_data(ds).run()
+        assert sorted(p.profiles) == ["q", "x"]
+
+    def test_all_null_pooled_column(self):
+        import pyarrow as pa
+
+        from deequ_tpu.data import Dataset
+
+        ds = Dataset.from_arrow(
+            pa.table(
+                {
+                    "a": pa.array([None] * 50, pa.float32()),
+                    "b": pa.array([1.5] * 50, pa.float32()),
+                }
+            )
+        )
+        ctx = AnalysisRunner.do_analysis_run(
+            ds,
+            [
+                ApproxCountDistinct("a"),
+                ApproxCountDistinct("b"),
+                ApproxQuantiles("a", [0.5]),
+                ApproxQuantiles("b", [0.5]),
+            ],
+        )
+        assert ctx.metric(ApproxCountDistinct("a")).value.get() == 0.0
+        assert ctx.metric(
+            ApproxCountDistinct("b")
+        ).value.get() == pytest.approx(1.0, rel=0.01)
+
+    def test_single_row_pooled(self):
+        from deequ_tpu.data import Dataset
+
+        ds = Dataset.from_pydict({"x": [2.5], "y": [3]})
+        ctx = AnalysisRunner.do_analysis_run(
+            ds,
+            [
+                ApproxCountDistinct("x"),
+                ApproxCountDistinct("y"),
+                ApproxQuantiles("x", [0.5]),
+                ApproxQuantiles("y", [0.5]),
+            ],
+        )
+        for c in ("x", "y"):
+            assert ctx.metric(
+                ApproxCountDistinct(c)
+            ).value.get() == pytest.approx(1.0, rel=0.01)
+
+    def test_where_filtered_hll_beside_unfiltered_kll(self):
+        from deequ_tpu.data import Dataset
+
+        ds = Dataset.from_pydict(
+            {"v": [1.0, 2.0, 2.0, 3.0] * 25, "g": [1, 0, 1, 0] * 25}
+        )
+        a = ApproxCountDistinct("v", where="g = 1")
+        ctx = AnalysisRunner.do_analysis_run(
+            ds, [a, ApproxQuantiles("v", [0.5])]
+        )
+        # where g=1 keeps values {1.0, 2.0}
+        assert ctx.metric(a).value.get() == pytest.approx(2.0, rel=0.01)
