@@ -259,6 +259,16 @@ def plan_frequency_passes(
     remaining = cap
 
     def note(plan, path):
+        # dual-write: the telemetry event feeds run captures/listeners/
+        # JSONL; the legacy ``events`` list keeps disabled-telemetry
+        # callers (and explicitly-passed metadata) intact
+        from deequ_tpu.telemetry import get_telemetry
+
+        tm = get_telemetry()
+        tm.counter(f"grouping.spill.{path}").inc()
+        tm.event(
+            "grouping_spill", columns=list(plan.columns), path=path
+        )
         if events is not None:
             events.append(
                 {

@@ -26,6 +26,7 @@ from deequ_tpu.analyzers.base import (
 from deequ_tpu.data.table import Dataset, Schema
 from deequ_tpu.engine.scan import AnalysisEngine
 from deequ_tpu.metrics.metric import Metric
+from deequ_tpu.telemetry import get_telemetry, merge_summaries
 from deequ_tpu.utils.observe import RunMetadata, timed_pass
 
 
@@ -38,10 +39,12 @@ from deequ_tpu.utils.observe import RunMetadata, timed_pass
 class AnalyzerContext:
     """Map analyzer -> metric (reference: AnalyzerContext.scala), plus
     per-pass wall-time metadata (deequ_tpu.utils.observe — beyond the
-    reference, SURVEY.md §5.1)."""
+    reference, SURVEY.md §5.1) and the raw telemetry run summary it was
+    derived from (deequ_tpu.telemetry; None when telemetry is off)."""
 
     metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
     run_metadata: Optional["RunMetadata"] = None
+    telemetry: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":
@@ -61,6 +64,7 @@ class AnalyzerContext:
             run_metadata=RunMetadata.merge_optional(
                 self.run_metadata, other.run_metadata
             ),
+            telemetry=merge_summaries([self.telemetry, other.telemetry]),
         )
 
     def success_metrics_as_records(
@@ -138,6 +142,8 @@ class AnalysisRunner:
         if not analyzers:
             return AnalyzerContext.empty()
         engine = engine or AnalysisEngine()
+        tm = get_telemetry()
+        tm.counter("runner.runs").inc()
 
         # 1) reuse existing metrics from the repository (SURVEY.md §2.4 (1))
         reused = AnalyzerContext.empty()
@@ -182,35 +188,56 @@ class AnalysisRunner:
         ]
 
         metrics: Dict[Analyzer, Metric] = dict(failures)
+        # explicit metadata stays the DISABLED-telemetry fallback: with
+        # telemetry on, the run capture below supersedes it
         metadata = RunMetadata()
         rows = data.num_rows
 
-        # 4+5) ONE fused scan for every scan-shareable analyzer AND
-        # every dense grouping frequency plan — a mixed verification
-        # suite costs a single pass over the data (SURVEY.md §2.4);
-        # device-sort/Arrow spill plans run right after, reusing the
-        # chunks the shared scan just cached
-        if scan_shareable or grouping:
-            with timed_pass(
-                metadata, "scan", rows, len(scan_shareable) + len(grouping)
-            ):
-                metrics.update(
-                    _run_fused_pass(
-                        data, scan_shareable, grouping, engine,
-                        aggregate_with, save_states_with, metadata,
+        with tm.run("analysis") as cap:
+            # 4+5) ONE fused scan for every scan-shareable analyzer AND
+            # every dense grouping frequency plan — a mixed verification
+            # suite costs a single pass over the data (SURVEY.md §2.4);
+            # device-sort/Arrow spill plans run right after, reusing the
+            # chunks the shared scan just cached
+            if scan_shareable or grouping:
+                with timed_pass(
+                    metadata, "scan", rows,
+                    len(scan_shareable) + len(grouping),
+                ):
+                    metrics.update(
+                        _run_fused_pass(
+                            data, scan_shareable, grouping, engine,
+                            aggregate_with, save_states_with, metadata,
+                        )
                     )
-                )
 
-        # 6) schema-only analyzers
-        for analyzer in others:
-            try:
-                metrics[analyzer] = analyzer.compute_directly(data)  # type: ignore[attr-defined]
-            except Exception as exc:  # noqa: BLE001
-                metrics[analyzer] = analyzer.to_failure_metric(exc)
+            # 6) schema-only analyzers
+            for analyzer in others:
+                try:
+                    metrics[analyzer] = analyzer.compute_directly(data)  # type: ignore[attr-defined]
+                except Exception as exc:  # noqa: BLE001
+                    metrics[analyzer] = analyzer.to_failure_metric(exc)
 
-        context = reused + AnalyzerContext(metrics, run_metadata=metadata)
+        summary = cap.final
+        if summary is not None:
+            metadata = RunMetadata.from_telemetry_summary(summary)
+        n_failed = sum(
+            1
+            for m in metrics.values()
+            if getattr(getattr(m, "value", None), "is_failure", False)
+        )
+        if n_failed:
+            tm.counter("runner.analyzer_failures").inc(n_failed)
+        for analyzer, metric in metrics.items():
+            tm.analyzer_computed(analyzer, metric)
 
-        # 7) optionally persist to the metrics repository
+        context = reused + AnalyzerContext(
+            metrics, run_metadata=metadata, telemetry=summary
+        )
+
+        # 7) optionally persist to the metrics repository — including
+        # this run's OPERATIONAL records (telemetry.oprecords), so
+        # anomaly strategies can trend the system's own throughput
         if metrics_repository is not None and save_or_append_results_with_key is not None:
             from deequ_tpu.repository.base import AnalysisResult
 
@@ -222,6 +249,12 @@ class AnalysisRunner:
                 if current is not None
                 else context
             )
+            if summary is not None:
+                from deequ_tpu.telemetry.oprecords import operational_metrics
+
+                op = operational_metrics(summary)
+                if op:
+                    combined = combined + AnalyzerContext(op)
             metrics_repository.save(
                 AnalysisResult(save_or_append_results_with_key, combined)
             )

@@ -36,19 +36,26 @@ import pyarrow.compute as pc
 ROW_MASK = "__row_mask__"
 
 # -- host->device transfer accounting (monotonic; bench snapshots it) ----
-_TRANSFER_BYTES = 0
+# The tally lives on the telemetry registry now (counter
+# "transfer.bytes" — always on, docs/OBSERVABILITY.md); these module
+# functions remain as the stable accessors. Looked up per call, not
+# cached: registry.reset() in tests would detach a cached instrument,
+# and the lookup is per-BATCH, not per-row.
+def _transfer_counter():
+    from deequ_tpu.telemetry import get_telemetry
+
+    return get_telemetry().counter("transfer.bytes")
 
 
 def add_transfer_bytes(n: int) -> None:
-    global _TRANSFER_BYTES
-    _TRANSFER_BYTES += int(n)
+    _transfer_counter().inc(int(n))
 
 
 def transfer_bytes() -> int:
     """Total bytes shipped host->device by the data layer so far.
     Monotonic; callers snapshot around a run to decompose wall time into
     link vs compute (VERDICT.md r2 weak #6)."""
-    return _TRANSFER_BYTES
+    return _transfer_counter().value
 
 
 @functools.lru_cache(maxsize=None)

@@ -114,6 +114,9 @@ def packed_device_get(tree: Any) -> Any:
     ]
     if not device_idx:
         return tree
+    from deequ_tpu.telemetry import get_telemetry
+
+    get_telemetry().counter("engine.device_fetches").inc()
     groups: Dict[str, list] = {}
     group_members: Dict[str, list] = {}
     for i in device_idx:

@@ -82,6 +82,7 @@ class ColumnProfiles:
     profiles: Dict[str, StandardColumnProfile]
     num_records: int
     run_metadata: Optional["object"] = None  # utils.observe.RunMetadata
+    telemetry: Optional[dict] = None  # merged telemetry run summary
 
     def __getitem__(self, column: str) -> StandardColumnProfile:
         return self.profiles[column]
@@ -297,6 +298,7 @@ class ColumnProfiler:
                 )
             else:
                 profiles[c] = StandardColumnProfile(**base)
+        from deequ_tpu.telemetry import merge_summaries
         from deequ_tpu.utils.observe import RunMetadata
 
         metadata = ctx1.run_metadata
@@ -305,7 +307,17 @@ class ColumnProfiler:
                 metadata, promoted_ctx.run_metadata
             )
         metadata = RunMetadata.merge_optional(metadata, ctx3.run_metadata)
-        return ColumnProfiles(profiles, num_records, run_metadata=metadata)
+        telemetry = merge_summaries(
+            [
+                ctx1.telemetry,
+                None if promoted_ctx is None else promoted_ctx.telemetry,
+                getattr(ctx3, "telemetry", None),
+            ]
+        )
+        return ColumnProfiles(
+            profiles, num_records, run_metadata=metadata,
+            telemetry=telemetry,
+        )
 
 
 def _cast_string_columns(data: Dataset, columns: Sequence[str]) -> Dataset:

@@ -16,7 +16,7 @@ import threading
 from typing import List, Optional
 
 from deequ_tpu.io.storage import storage_for
-from deequ_tpu.repository import serde
+from deequ_tpu.repository import base, serde
 from deequ_tpu.repository.base import (
     AnalysisResult,
     MetricsRepository,
@@ -71,6 +71,7 @@ class FileSystemMetricsRepository(MetricsRepository):
         )
 
     def save(self, result: AnalysisResult) -> None:
+        base._bump("repository.saves")
         with self._lock:
             results = [
                 r
@@ -81,6 +82,7 @@ class FileSystemMetricsRepository(MetricsRepository):
             self._write_all(results)
 
     def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:
+        base._bump("repository.loads")
         with self._lock:
             for result in self._read_all():
                 if result.result_key == key:
@@ -88,5 +90,6 @@ class FileSystemMetricsRepository(MetricsRepository):
         return None
 
     def load(self) -> MetricsRepositoryMultipleResultsLoader:
+        base._bump("repository.loads")
         with self._lock:
             return MetricsRepositoryMultipleResultsLoader(self._read_all())

@@ -62,6 +62,7 @@ from deequ_tpu.metrics.metric import (
 )
 from deequ_tpu.repository.base import AnalysisResult, ResultKey
 from deequ_tpu.sketches.kll import KLLParameters
+from deequ_tpu.telemetry.oprecords import OperationalAnalyzer
 from deequ_tpu.utils.trylike import Failure, Success
 
 ANALYZER_REGISTRY: Dict[str, Type[Analyzer]] = {
@@ -94,6 +95,9 @@ ANALYZER_REGISTRY: Dict[str, Type[Analyzer]] = {
         Sum,
         Uniqueness,
         UniqueValueRatio,
+        # telemetry's repository-persisted operational records ride the
+        # same serde path as data-quality metrics
+        OperationalAnalyzer,
     )
 }
 

@@ -1,0 +1,75 @@
+"""Unified telemetry: spans, counters, run listeners, structured export.
+
+The one-stop observability layer (docs/OBSERVABILITY.md):
+
+- :mod:`spans` — nested attribute-carrying spans with thread-local
+  context, each doubling as a ``jax.profiler.TraceAnnotation``
+- :mod:`metrics` — always-on counters/gauges/latency histograms with a
+  Prometheus text exposition
+- :mod:`listeners` — Spark-listener-style run callbacks
+- :mod:`runtime` — the :class:`Telemetry` object tying them together,
+  with per-run captures and a JSONL event log
+- :mod:`export` — summary serde/merging and JSONL reading
+- :mod:`oprecords` — repository-persisted per-run operational records
+  (imported lazily by the runner/serde; not re-exported here to keep
+  this package importable from the data layer without cycles)
+- :mod:`phases` — the scan wall-decomposition clock
+
+``get_telemetry()`` returns the process default; ``configure(...)``
+flips ``enabled``/``jsonl_path`` on it. Counters stay live even when
+disabled (monotonic accounting, e.g. ``transfer.bytes``); everything
+else becomes a shared no-op.
+"""
+
+from deequ_tpu.telemetry.export import (
+    merge_summaries,
+    read_jsonl,
+    summarize_phases,
+    summary_from_json,
+    summary_to_json,
+)
+from deequ_tpu.telemetry.listeners import CollectingRunListener, RunListener
+from deequ_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from deequ_tpu.telemetry.phases import PhaseClock
+from deequ_tpu.telemetry.runtime import (
+    RunCapture,
+    Telemetry,
+    configure,
+    get_telemetry,
+)
+from deequ_tpu.telemetry.spans import (
+    NOOP_SPAN,
+    Span,
+    Tracer,
+    clock,
+    profiler_trace,
+)
+
+__all__ = [
+    "CollectingRunListener",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NOOP_SPAN",
+    "PhaseClock",
+    "RunCapture",
+    "RunListener",
+    "Span",
+    "Telemetry",
+    "Tracer",
+    "clock",
+    "configure",
+    "get_telemetry",
+    "merge_summaries",
+    "profiler_trace",
+    "read_jsonl",
+    "summarize_phases",
+    "summary_from_json",
+    "summary_to_json",
+]

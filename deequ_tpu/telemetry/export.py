@@ -1,0 +1,83 @@
+"""Structured export helpers: summary serde, summary merging, and
+JSONL artifact reading.
+
+The *summary* is the per-run dict produced by ``RunCapture.summary``
+(runtime.py) and attached to ``AnalyzerContext``/``VerificationResult``
+— plain JSON-serializable data by construction, so persistence is
+``json.dumps``/``loads`` with a round-trip identity (tested in
+tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def summary_to_json(summary: Dict[str, Any], indent: int = 2) -> str:
+    return json.dumps(summary, indent=indent, default=str)
+
+
+def summary_from_json(text: str) -> Dict[str, Any]:
+    return json.loads(text)
+
+
+def merge_summaries(
+    summaries: Sequence[Optional[Dict[str, Any]]],
+) -> Optional[Dict[str, Any]]:
+    """Fold several per-run summaries (e.g. the profiler's passes over
+    the same dataset) into one: walls add, pass/event/span lists
+    concatenate in order, counter deltas add. ``None`` entries are
+    skipped; all-None means no telemetry was captured."""
+    present = [s for s in summaries if s]
+    if not present:
+        return None
+    if len(present) == 1:
+        return present[0]
+    counters: Dict[str, float] = {}
+    for s in present:
+        for k, v in s.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    return {
+        "run_id": present[0].get("run_id"),
+        "run_ids": [s.get("run_id") for s in present],
+        "name": present[0].get("name", "run"),
+        "wall_s": sum(s.get("wall_s", 0.0) for s in present),
+        "passes": [p for s in present for p in s.get("passes", [])],
+        "events": [e for s in present for e in s.get("events", [])],
+        "spans": [sp for s in present for sp in s.get("spans", [])],
+        "counters": counters,
+    }
+
+
+def summarize_phases(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Sum ``scan_phases`` events into one wall-decomposition dict (the
+    shape bench.py and tools/obs_report.py report)."""
+    out: Dict[str, Any] = {}
+    for e in events:
+        if e.get("event") != "scan_phases":
+            continue
+        for k, v in e.items():
+            if isinstance(v, float):
+                out[k] = out.get(k, 0.0) + v
+        out["scan_passes"] = out.get("scan_passes", 0) + 1
+    return {
+        k: (round(v, 3) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL artifact (skips unparseable lines — the
+    log may be appended by several processes)."""
+    records: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records

@@ -1,0 +1,59 @@
+"""PhaseClock: wall-time decomposition buckets for the scan paths.
+
+Lives in the telemetry layer so the engine's hot loop contains no clock
+calls of its own (tools/telemetry_lint.py enforces that split — all
+attribution comes from ONE place and stays comparable across PRs).
+
+Buckets: ``host_wait_s`` — blocked pulling the staging generator
+(source read/convert; on the resident path this also covers the
+device_put DISPATCH of chunk staging); ``put_s`` — transfer dispatch
+incl. link backpressure; ``dispatch_s`` — jitted step dispatch (the
+FIRST step's trace+compile is split out as ``first_step_s`` so a cold
+run doesn't read as dispatch overhead); ``sync_s`` — blocked on the
+device queue draining.
+
+Attribution caveat (measured, docs/PERF.md): when the host->device link
+saturates, backpressure and GIL contention smear waiting across
+buckets — the SUM (~= wall) and bytes_shipped/wall are the robust
+signals; individual buckets are indicative.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator
+
+
+class PhaseClock:
+    def __init__(self, mode: str):
+        self.times: Dict[str, object] = {
+            "host_wait_s": 0.0, "put_s": 0.0, "dispatch_s": 0.0,
+            "first_step_s": 0.0, "sync_s": 0.0, "mode": mode,
+        }
+        self._steps = 0
+
+    def timed_iter(self, iterator) -> Iterator:
+        """Yield from ``iterator``, accumulating time blocked in its
+        ``__next__`` into host_wait_s (keeps the caller a for-loop)."""
+        it = iter(iterator)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self.times["host_wait_s"] += time.perf_counter() - t0
+            yield item
+
+    @contextlib.contextmanager
+    def phase(self, key: str) -> Iterator[None]:
+        if key == "dispatch_s":
+            self._steps += 1
+            if self._steps == 1:
+                key = "first_step_s"
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.times[key] += time.perf_counter() - t0

@@ -1,0 +1,157 @@
+"""Span tracer: nested, attribute-carrying spans with thread-local
+context.
+
+Each finished span carries (name, span_id, parent_id, thread, wall_s,
+attributes); nesting is tracked per-thread, so concurrent runs (or the
+engine's prefetch worker) can never corrupt each other's parentage.
+When annotation is on and jax is importable, every span also emits a
+``jax.profiler.TraceAnnotation`` under the SAME ``deequ_tpu:<name>``
+label — an XProf/TensorBoard trace and the in-repo timings share names,
+so a kernel-level investigation and a span report line up 1:1.
+
+The clock helpers here are the ONE sanctioned home of
+``time.perf_counter`` — hot-path modules must route timing through this
+layer (enforced by tools/telemetry_lint.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional
+
+_span_ids = itertools.count(1)
+
+
+def clock() -> float:
+    """Monotonic seconds — the sanctioned timing source for callers
+    outside the telemetry layer (see tools/telemetry_lint.py)."""
+    return time.perf_counter()
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    thread: str
+    started_at: float  # epoch seconds (export ordering across threads)
+    wall_s: float = 0.0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def as_record(self) -> Dict[str, Any]:
+        return {
+            "type": "span",
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "thread": self.thread,
+            "started_at": round(self.started_at, 6),
+            "wall_s": round(self.wall_s, 6),
+            "attributes": dict(self.attributes),
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path."""
+
+    __slots__ = ()
+    wall_s = 0.0
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+# reusable: nullcontext always returns its enter_result, so ONE instance
+# serves every disabled span() call with zero allocation
+NOOP_SPAN_CM = contextlib.nullcontext(NOOP_SPAN)
+
+
+def _trace_annotation(name: str):
+    """A jax TraceAnnotation for ``name``, or None when jax is absent
+    (telemetry stays importable without an accelerator stack)."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(f"deequ_tpu:{name}")
+    except Exception:  # noqa: BLE001 — annotation is best-effort
+        return None
+
+
+class Tracer:
+    """Thread-safe span context. Each thread owns its span stack; the
+    finished-span callback is invoked on the finishing thread."""
+
+    def __init__(self, annotate: bool = True):
+        self.annotate = annotate
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        on_finish: Optional[Callable[[Span], None]] = None,
+        **attributes: Any,
+    ) -> Iterator[Span]:
+        stack = self._stack()
+        sp = Span(
+            name=name,
+            span_id=next(_span_ids),
+            parent_id=stack[-1].span_id if stack else None,
+            thread=threading.current_thread().name,
+            started_at=time.time(),
+            attributes=dict(attributes),
+        )
+        stack.append(sp)
+        annotation = _trace_annotation(name) if self.annotate else None
+        t0 = time.perf_counter()
+        try:
+            if annotation is None:
+                yield sp
+            else:
+                with annotation:
+                    yield sp
+        finally:
+            sp.wall_s = time.perf_counter() - t0
+            # pop by identity: an exception while a child span is still
+            # open must not mis-pop the parent
+            if stack and stack[-1] is sp:
+                stack.pop()
+            elif sp in stack:
+                stack.remove(sp)
+            if on_finish is not None:
+                on_finish(sp)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace of the wrapped block into
+    ``log_dir`` (open with TensorBoard's profile plugin / XProf).
+    Span TraceAnnotations emitted inside the block appear in the dump
+    under their ``deequ_tpu:<name>`` labels."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,18 +1,18 @@
-"""Observability: per-pass wall-time metadata + jax.profiler hooks.
+"""Observability compatibility layer over deequ_tpu.telemetry.
 
-The reference has NO in-repo execution tracing — observability is
-delegated to the Spark UI (SURVEY.md §5.1 calls this "a gap we can
-exceed"). Here every analysis run records a :class:`PassTiming` per
-engine pass (fused scan, frequency pass, direct analyzers), attached to
-the AnalyzerContext / VerificationResult, and :func:`profiler_trace`
-wraps a block in a jax.profiler trace whose dump opens in
-TensorBoard/XProf for kernel-level timing.
+Historically this module owned per-pass wall-time metadata and the
+jax.profiler hooks; those now live in :mod:`deequ_tpu.telemetry`
+(spans, counters, run listeners, JSONL export — docs/OBSERVABILITY.md).
+:class:`RunMetadata`/:class:`PassTiming` remain as the stable
+result-facing shape (``ctx.run_metadata``), built FROM telemetry run
+summaries via :meth:`RunMetadata.from_telemetry_summary`; the context
+managers below are thin delegating shims kept for callers of the old
+API.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional
 
@@ -66,6 +66,22 @@ class RunMetadata:
             return b.merge(None)
         return a.merge(b)
 
+    @staticmethod
+    def from_telemetry_summary(
+        summary: Optional[dict],
+    ) -> Optional["RunMetadata"]:
+        """The compatibility adapter: rebuild the classic pass/event
+        shape from a telemetry run summary (runtime.RunCapture)."""
+        if summary is None:
+            return None
+        metadata = RunMetadata()
+        for p in summary.get("passes", []):
+            metadata.record(
+                p["pass"], p["wall_s"], p["rows"], p["num_analyzers"]
+            )
+        metadata.events.extend(summary.get("events", []))
+        return metadata
+
     def as_records(self) -> List[dict]:
         return [
             {
@@ -86,26 +102,23 @@ def timed_pass(
     rows: int,
     num_analyzers: int,
 ) -> Iterator[None]:
-    """Time a pass (and annotate it for an active jax.profiler trace)."""
+    """Deprecated shim: time a pass through the telemetry layer (span +
+    TraceAnnotation + listener callbacks) and record it into
+    ``metadata``. Prefer ``get_telemetry().pass_span(...)``."""
     if metadata is None:
         yield
         return
-    import jax
+    from deequ_tpu.telemetry import get_telemetry
 
-    start = time.perf_counter()
-    with jax.profiler.TraceAnnotation(f"deequ_tpu:{name}"):
+    with get_telemetry().pass_span(name, rows, num_analyzers) as span:
         yield
-    metadata.record(name, time.perf_counter() - start, rows, num_analyzers)
+    metadata.record(name, span.wall_s, rows, num_analyzers)
 
 
 @contextlib.contextmanager
 def profiler_trace(log_dir: str) -> Iterator[None]:
-    """Capture a jax.profiler trace of the wrapped block into
-    ``log_dir`` (open with TensorBoard's profile plugin / XProf)."""
-    import jax
+    """Deprecated shim for :func:`deequ_tpu.telemetry.profiler_trace`."""
+    from deequ_tpu.telemetry import profiler_trace as _trace
 
-    jax.profiler.start_trace(log_dir)
-    try:
+    with _trace(log_dir):
         yield
-    finally:
-        jax.profiler.stop_trace()

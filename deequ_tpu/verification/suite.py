@@ -43,6 +43,7 @@ class VerificationResult:
         self.metrics = metrics
         self._data = data  # for row-level results; None on state-only runs
         self.run_metadata = None  # per-pass timings (set by the suite)
+        self.telemetry = None  # telemetry run summary (set by the suite)
 
     def row_level_results_as_dataset(
         self,
@@ -159,7 +160,14 @@ class VerificationSuite:
         context: AnalyzerContext,
         data: Optional[Dataset] = None,
     ) -> VerificationResult:
+        from deequ_tpu.telemetry import get_telemetry
+
+        tm = get_telemetry()
         check_results = {check: check.evaluate(context) for check in checks}
+        if check_results:
+            tm.counter("checks.evaluated").inc(len(check_results))
+        for check, check_result in check_results.items():
+            tm.check_evaluated(check, check_result)
         if not check_results:
             status = CheckStatus.SUCCESS
         else:
@@ -172,6 +180,7 @@ class VerificationSuite:
             status, check_results, context.metric_map, data=data
         )
         result.run_metadata = context.run_metadata
+        result.telemetry = context.telemetry
         return result
 
 

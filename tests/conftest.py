@@ -12,6 +12,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Hermetic compile cache: loading a persistent-cache executable written
+# earlier in the same session aborts the whole process (SIGABRT inside
+# XLA CPU) in test_differential's mesh test on this jax build —
+# reproducibly, even with a freshly-emptied cache directory. Disable
+# the cache for tests; the suite recompiles everything and stays well
+# inside the timing budget.
+os.environ["DEEQU_TPU_COMPILE_CACHE"] = ""
 
 import jax  # noqa: E402
 

@@ -1,0 +1,145 @@
+"""Render a human-readable run breakdown from a telemetry JSONL artifact.
+
+Usage:
+
+    python -m tools.obs_report runs.jsonl            # all runs
+    python -m tools.obs_report runs.jsonl --run 3    # one run
+    python -m tools.obs_report runs.jsonl --counters # counter totals only
+
+The artifact is produced by ``deequ_tpu.telemetry.configure(
+jsonl_path=...)`` (or ``DEEQU_TPU_TELEMETRY_JSONL``); every finished
+span, engine event, and run summary is one JSON line. See
+docs/OBSERVABILITY.md for line shapes and the counter catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+from deequ_tpu.telemetry import read_jsonl, summarize_phases
+
+
+def load_runs(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The run_summary lines, in file order."""
+    return [r for r in records if r.get("type") == "run_summary"]
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def render_run(summary: Dict[str, Any]) -> str:
+    """One run's breakdown: pass table, wall decomposition, counters."""
+    lines = []
+    run_id = summary.get("run_id", "?")
+    name = summary.get("name", "run")
+    wall = float(summary.get("wall_s", 0.0))
+    lines.append(f"run {run_id} ({name}): wall {wall:.3f}s")
+
+    passes = summary.get("passes", [])
+    if passes:
+        lines.append("  passes:")
+        for p in passes:
+            p_wall = float(p.get("wall_s", 0.0))
+            rows = int(p.get("rows", 0))
+            rps = rows / p_wall if p_wall > 0 else 0.0
+            share = 100.0 * p_wall / wall if wall > 0 else 0.0
+            lines.append(
+                f"    {p.get('pass', '?'):<10} {p_wall:8.3f}s"
+                f"  ({share:5.1f}% of wall)"
+                f"  rows={rows:<10} analyzers={p.get('num_analyzers', 0):<4}"
+                f" {rps:,.0f} rows/s"
+            )
+
+    phases = summarize_phases(summary.get("events", []))
+    if phases:
+        lines.append("  scan wall decomposition "
+                     f"({phases.get('scan_passes', 0)} scan(s)):")
+        for key in ("host_wait_s", "put_s", "dispatch_s", "first_step_s",
+                    "sync_s"):
+            if key in phases:
+                lines.append(f"    {key:<14} {phases[key]:8.3f}s")
+
+    spills = [
+        e for e in summary.get("events", [])
+        if e.get("event") == "grouping_spill"
+    ]
+    if spills:
+        lines.append("  grouping spills:")
+        for e in spills:
+            lines.append(
+                f"    {','.join(e.get('columns', []))} -> {e.get('path')}"
+            )
+
+    counters = summary.get("counters", {})
+    if counters:
+        lines.append("  counters (delta over run):")
+        for k in sorted(counters):
+            v = counters[k]
+            shown = _fmt_bytes(v) if k == "transfer.bytes" else str(v)
+            lines.append(f"    {k:<32} {shown}")
+    return "\n".join(lines)
+
+
+def render(
+    records: List[Dict[str, Any]],
+    run_id: Optional[int] = None,
+    counters_only: bool = False,
+) -> str:
+    runs = load_runs(records)
+    if run_id is not None:
+        runs = [r for r in runs if r.get("run_id") == run_id]
+        if not runs:
+            return f"no run_summary with run_id={run_id}"
+    if counters_only:
+        totals: Dict[str, float] = {}
+        for r in runs:
+            for k, v in r.get("counters", {}).items():
+                totals[k] = totals.get(k, 0) + v
+        lines = [f"counter totals over {len(runs)} run(s):"]
+        for k in sorted(totals):
+            v = totals[k]
+            shown = _fmt_bytes(v) if k == "transfer.bytes" else str(int(v))
+            lines.append(f"  {k:<32} {shown}")
+        return "\n".join(lines)
+    if not runs:
+        n_spans = sum(1 for r in records if r.get("type") == "span")
+        n_events = sum(1 for r in records if r.get("type") == "event")
+        return (
+            f"no run summaries in artifact ({n_spans} spans, "
+            f"{n_events} events) — was a run context "
+            "(telemetry.run(...)) active?"
+        )
+    return "\n\n".join(render_run(r) for r in runs)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render run breakdowns from a telemetry JSONL artifact"
+    )
+    parser.add_argument("path", help="telemetry JSONL file")
+    parser.add_argument(
+        "--run", type=int, default=None, help="render only this run_id"
+    )
+    parser.add_argument(
+        "--counters", action="store_true",
+        help="print only counter totals across runs",
+    )
+    args = parser.parse_args(argv)
+    try:
+        records = read_jsonl(args.path)
+    except OSError as exc:
+        print(f"cannot read {args.path}: {exc}", file=sys.stderr)
+        return 2
+    print(render(records, run_id=args.run, counters_only=args.counters))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

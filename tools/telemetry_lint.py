@@ -1,0 +1,101 @@
+"""Lint: hot-path modules must not roll their own timing/tracing.
+
+All wall-clock attribution lives in ``deequ_tpu/telemetry/`` (spans,
+PhaseClock, pass timing) so trace names stay consistent with XProf and
+timings stay comparable across PRs. This tool tokenizes every module
+under the hot-path packages and flags ``time.perf_counter``,
+``jax.profiler.start_trace``/``stop_trace``, and ``TraceAnnotation``
+references outside the telemetry layer. Run from the test suite
+(tests/test_telemetry.py) and by hand:
+
+    python -m tools.telemetry_lint [repo_root]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+from typing import List, Optional, Tuple
+
+# packages whose modules the fused-scan / verification flow executes;
+# utils is included (observe.py is a pure adapter now)
+HOT_PATH_DIRS = (
+    "deequ_tpu/engine",
+    "deequ_tpu/data",
+    "deequ_tpu/analyzers",
+    "deequ_tpu/profiles",
+    "deequ_tpu/verification",
+    "deequ_tpu/sketches",
+    "deequ_tpu/checks",
+    "deequ_tpu/io",
+    "deequ_tpu/utils",
+)
+
+# NAME tokens that mean "module does its own timing/tracing"
+FORBIDDEN_NAMES = frozenset(
+    {"perf_counter", "start_trace", "stop_trace", "TraceAnnotation"}
+)
+
+# the one place allowed to touch clocks and the profiler
+EXEMPT_PREFIX = "deequ_tpu/telemetry/"
+
+
+def find_violations(root: str) -> List[Tuple[str, int, str]]:
+    """(relpath, line, token) for every forbidden NAME token in a
+    hot-path module. Tokenize-based: a mention in a comment or docstring
+    does not flag; an aliased import (``from time import perf_counter``)
+    does."""
+    violations: List[Tuple[str, int, str]] = []
+    for rel_dir in HOT_PATH_DIRS:
+        top = os.path.join(root, rel_dir)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(top):
+            for filename in sorted(filenames):
+                if not filename.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, filename)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                if rel.startswith(EXEMPT_PREFIX):
+                    continue
+                with open(path, "rb") as fh:
+                    source = fh.read()
+                try:
+                    tokens = tokenize.tokenize(
+                        io.BytesIO(source).readline
+                    )
+                    for tok in tokens:
+                        if (
+                            tok.type == tokenize.NAME
+                            and tok.string in FORBIDDEN_NAMES
+                        ):
+                            violations.append(
+                                (rel, tok.start[0], tok.string)
+                            )
+                except tokenize.TokenizeError:
+                    violations.append((rel, 0, "<tokenize error>"))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = find_violations(root)
+    for rel, line, token in violations:
+        print(f"{rel}:{line}: {token} outside deequ_tpu/telemetry/")
+    if violations:
+        print(
+            f"{len(violations)} violation(s): timing/tracing belongs in "
+            "the telemetry layer (docs/OBSERVABILITY.md)"
+        )
+        return 1
+    print("telemetry lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
