@@ -197,6 +197,11 @@ class ScanOps:
     # - None: EXPLICIT opt-out — never reuse a compiled plan containing
     #   this op (dataset-derived constants baked into the closure).
     cache_token: Optional[object] = CACHE_TOKEN_AUTO
+    # collector ops (one-pass spill): the final state is a device-
+    # resident key buffer consumed by a post-scan sort finalize — the
+    # engine excludes it from the epilogue's packed fetch instead of
+    # round-tripping megabytes of keys through the host.
+    device_result: bool = False
 
     def apply_update(self, state, batch, consts):
         if self.consts is None:

@@ -239,18 +239,26 @@ def plan_frequency_passes(
     """Split frequency plans into execution strategies WITHOUT running
     anything yet, so dense plans can ride the caller's shared scan:
 
-    returns ``(dense_specs, deferred)`` where
+    returns ``(dense_specs, collectors, deferred)`` where
     - ``dense_specs`` is a list of ``(plan, dictionaries, sizes,
       requests, ops)`` — ScanOps for the shared fused scan, finalized
       via :func:`finalize_dense_states`;
-    - ``deferred`` maps plan -> zero-arg callable running the device
-      sort+segment spill (analyzers/spill.py) or the host Arrow
-      fallback. Spill decisions are recorded in ``events`` so a
-      100x-slower high-card pass is visible in run metadata instead of
-      silent (VERDICT r2 weak #8)."""
+    - ``collectors`` is a list of :class:`spill.CollectorSpec` — spill
+      plans whose u64 key extraction ALSO rides the shared fused scan
+      (one-pass spill), finalized via
+      :func:`finalize_collector_states`. Empty when
+      ``config.options().one_pass_spill`` is off;
+    - ``deferred`` maps plan -> zero-arg callable running the
+      per-plan deferred re-scan spill (analyzers/spill.py) or the
+      host Arrow fallback. Spill decisions are recorded in ``events``
+      so a 100x-slower high-card pass is visible in run metadata
+      instead of silent (VERDICT r2 weak #8)."""
+    from deequ_tpu import config
     from deequ_tpu.analyzers import spill as spill_mod
 
     engine = engine or AnalysisEngine()
+    use_collectors = config.options().one_pass_spill
+    collectors: List = []
     cap, count_dtype = _dense_joint_cap(dataset.num_rows)
     dense: List[Tuple] = []
     deferred: Dict[FrequencyPlan, object] = {}
@@ -301,12 +309,43 @@ def plan_frequency_passes(
 
         return run
 
+    def make_collector(plan, build_spec, deferred_thunk):
+        """Route a spill plan onto the shared fused scan: build its
+        CollectorSpec and wire the three exits — success telemetry,
+        SpillOverflow -> host Arrow, shared-scan failure -> the plan's
+        own deferred re-scan thunk. A spec BUILD failure (geometry or
+        key-builder trace issues) quietly keeps the deferred twin."""
+        try:
+            spec = build_spec()
+        except Exception:  # noqa: BLE001
+            deferred[plan] = deferred_thunk
+            return
+
+        spec.on_success = lambda: note(plan, spec.path)
+
+        def overflow_fallback():
+            note(plan, "host-arrow-overflow")
+            return _arrow_frequencies(dataset, plan)
+
+        spec.overflow_fallback = overflow_fallback
+        spec.scan_fallback = deferred_thunk
+        collectors.append(spec)
+
     for plan in plans:
         # a plan eligible for the device sort path never probes the
         # dictionary at all — no host-side distinct set is built for a
         # high-cardinality numeric key column
         if spill_mod.device_spill_eligible(dataset, plan, engine):
-            deferred[plan] = make_spill(plan)
+            if use_collectors:
+                make_collector(
+                    plan,
+                    lambda p=plan: spill_mod.single_collector_spec(
+                        dataset, p, engine
+                    ),
+                    make_spill(plan),
+                )
+            else:
+                deferred[plan] = make_spill(plan)
             continue
         # capped distinct counts first: a spilling plan must never
         # materialize an unbounded value set on the host (probe with the
@@ -382,10 +421,21 @@ def plan_frequency_passes(
 
                 return run
 
-            deferred[plan] = make_joint(plan, dictionaries, sizes)
+            if use_collectors:
+                make_collector(
+                    plan,
+                    lambda p=plan, d=dictionaries, s=sizes: (
+                        spill_mod.joint_collector_spec(
+                            dataset, p, engine, d, s
+                        )
+                    ),
+                    make_joint(plan, dictionaries, sizes),
+                )
+            else:
+                deferred[plan] = make_joint(plan, dictionaries, sizes)
         else:
             deferred[plan] = make_arrow(plan)
-    return dense, deferred
+    return dense, collectors, deferred
 
 
 def finalize_dense_states(
@@ -411,6 +461,57 @@ def finalize_dense_states(
     return out
 
 
+def finalize_collector_states(
+    collectors, states, isolate: bool = False
+) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
+    """Finish every one-pass spill plan from its shared-scan collector
+    state. Dispatch order matters for latency: EVERY plan's sort +
+    segment-count launches (async) before ANY result is fetched, so
+    the per-plan device sorts overlap; then ONE packed transfer brings
+    back all the pending scalars and each plan's state object builds
+    host-side. ``SpillOverflow`` (sharded hash bucket past capacity)
+    takes the plan's host-Arrow fallback. With ``isolate`` set, other
+    exceptions become the plan's dict value (the runner's per-plan
+    failure-metric contract) instead of propagating."""
+    from deequ_tpu.analyzers.spill import SpillOverflow
+    from deequ_tpu.engine.pack import packed_device_get
+
+    out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
+    launched = []  # (spec, build) with a slot in the pending tree
+    pendings = []
+    for spec, state in zip(collectors, states):
+        try:
+            pending, build = spec.dispatch(state)
+        except Exception as exc:  # noqa: BLE001 — finalize trace died;
+            # the data was consumed, so re-scan via the deferred twin
+            try:
+                out[spec.plan] = spec.scan_fallback()
+            except Exception as fallback_exc:  # noqa: BLE001
+                if not isolate:
+                    raise
+                out[spec.plan] = fallback_exc
+            continue
+        launched.append((spec, build))
+        pendings.append(pending)
+    fetched = packed_device_get(tuple(pendings))
+    for (spec, build), got in zip(launched, fetched):
+        try:
+            out[spec.plan] = build(got)
+            spec.on_success()
+        except SpillOverflow:
+            try:
+                out[spec.plan] = spec.overflow_fallback()
+            except Exception as exc:  # noqa: BLE001
+                if not isolate:
+                    raise
+                out[spec.plan] = exc
+        except Exception as exc:  # noqa: BLE001
+            if not isolate:
+                raise
+            out[spec.plan] = exc
+    return out
+
+
 def compute_many_frequencies(
     dataset: Dataset,
     plans: Sequence[FrequencyPlan],
@@ -427,23 +528,32 @@ def compute_many_frequencies(
     AnalysisRunner fuses dense plans into its MAIN scan instead via
     plan_frequency_passes; this entry point runs them standalone.)"""
     engine = engine or AnalysisEngine()
-    dense, deferred = plan_frequency_passes(dataset, plans, engine, events)
+    dense, collectors, deferred = plan_frequency_passes(
+        dataset, plans, engine, events
+    )
     results: Dict[FrequencyPlan, FrequenciesAndNumRows] = {
         plan: run() for plan, run in deferred.items()
     }
-    if dense:
+    if dense or collectors:
         states = engine.run_scan(
             dataset,
             [
                 (FrequencyScanAdapter(requests), ops)
                 for (_p, _d, _s, requests, ops) in dense
+            ]
+            + [
+                (FrequencyScanAdapter(spec.requests), spec.ops)
+                for spec in collectors
             ],
         )
         if events is not None and engine.phase_times is not None:
             # same one-event-per-run_scan contract as the runner's
             # fused pass, so _phases-style consumers see every scan
             events.append({"event": "scan_phases", **engine.phase_times})
-        results.update(finalize_dense_states(dense, states))
+        results.update(finalize_dense_states(dense, states[: len(dense)]))
+        results.update(
+            finalize_collector_states(collectors, states[len(dense):])
+        )
     return results
 
 
@@ -662,6 +772,9 @@ def _arrow_frequencies(
     Without a where-filter this STREAMS record batches — group_by per
     chunk, then the vectorized sparse merge — so memory is O(chunk +
     distinct), and parquet sources are never fully loaded."""
+    from deequ_tpu.analyzers.spill import _count_data_pass
+
+    _count_data_pass()  # host group_by reads the whole source once
     columns = list(plan.columns)
     if plan.where is None:
         # group each chunk in Arrow, stash the (small) grouped tables,

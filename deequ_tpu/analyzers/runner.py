@@ -341,17 +341,22 @@ def _run_fused_pass(
     metadata=None,
 ) -> Dict[Analyzer, Metric]:
     """Plan + run THE fused scan: scan-shareable analyzers (vectorized
-    into stacked group ops, engine/vectorize.py) and dense grouping
-    frequency plans (scatter-add ScanOps, analyzers/grouping.py) all
-    ride one engine.run_scan — one pass over the data, one packed state
-    fetch. Device-sort / Arrow spill plans execute immediately after
-    against the chunks the scan cached. Per-analyzer plan failures (bad
+    into stacked group ops, engine/vectorize.py), dense grouping
+    frequency plans (scatter-add ScanOps, analyzers/grouping.py), AND
+    high-cardinality spill plans (one-pass key collectors,
+    analyzers/spill.py) all ride one engine.run_scan — one pass over
+    the data, one packed state fetch, then every spill plan's sort
+    finalize dispatched before any result is fetched so the per-plan
+    sorts overlap. Only host-Arrow fallbacks (and collectors disabled
+    via config.one_pass_spill) re-read the source. Per-analyzer plan
+    failures (bad
     predicate, unknown column inside an expression) degrade to failure
     metrics without aborting the shared pass; each vectorized member's
     ordinary state is sliced back out afterwards, so persistence/merge
     semantics are identical to the single path."""
     from deequ_tpu.analyzers.grouping import (
         FrequencyScanAdapter,
+        finalize_collector_states,
         finalize_dense_states,
         finalize_grouping_metrics,
         plan_frequency_passes,
@@ -365,10 +370,10 @@ def _run_fused_pass(
         metrics[analyzer] = analyzer.to_failure_metric(exc)
 
     by_plan = plans_for(grouping)
-    dense, deferred = [], {}
+    dense, collectors, deferred = [], [], {}
     if by_plan:
         try:
-            dense, deferred = plan_frequency_passes(
+            dense, collectors, deferred = plan_frequency_passes(
                 data,
                 list(by_plan.keys()),
                 engine,
@@ -379,12 +384,19 @@ def _run_fused_pass(
             for group in by_plan.values():
                 for analyzer in group:
                     metrics[analyzer] = analyzer.to_failure_metric(exc)
-            by_plan, dense, deferred = {}, [], {}
+            by_plan, dense, collectors, deferred = {}, [], [], {}
 
-    scan_pairs = [(unit, unit.ops) for unit in units] + [
-        (FrequencyScanAdapter(requests), ops)
-        for (_p, _d, _s, requests, ops) in dense
-    ]
+    scan_pairs = (
+        [(unit, unit.ops) for unit in units]
+        + [
+            (FrequencyScanAdapter(requests), ops)
+            for (_p, _d, _s, requests, ops) in dense
+        ]
+        + [
+            (FrequencyScanAdapter(spec.requests), spec.ops)
+            for spec in collectors
+        ]
+    )
     if not scan_pairs and not deferred:
         return metrics
 
@@ -405,6 +417,12 @@ def _run_fused_pass(
                 for analyzer in by_plan.get(plan, []):
                     metrics[analyzer] = analyzer.to_failure_metric(wrapped)
             dense = []
+            # a shared-scan failure must not take the spill plans down
+            # with it (they ran independently before one-pass fusion):
+            # each collector degrades to its own deferred re-scan
+            for spec in collectors:
+                deferred[spec.plan] = spec.scan_fallback
+            collectors = []
 
     if states is not None:
         for unit, unit_state in zip(units, states[: len(units)]):
@@ -433,13 +451,26 @@ def _run_fused_pass(
     # must not discard its siblings' valid states)
     frequencies: Dict[Any, Any] = {}
     if states is not None and dense:
-        for spec, state in zip(dense, states[len(units):]):
+        for spec, state in zip(
+            dense, states[len(units): len(units) + len(dense)]
+        ):
             try:
                 frequencies.update(
                     finalize_dense_states([spec], [state])
                 )
             except Exception as exc:  # noqa: BLE001
                 frequencies[spec[0]] = exc
+    if states is not None and collectors:
+        # dispatch every plan's sort finalize before fetching any
+        # result (finalize_collector_states) so the sorts overlap;
+        # isolate: one plan's failure stays its own failure metric
+        frequencies.update(
+            finalize_collector_states(
+                collectors,
+                states[len(units) + len(dense):],
+                isolate=True,
+            )
+        )
     for plan, run in deferred.items():
         try:
             frequencies[plan] = run()

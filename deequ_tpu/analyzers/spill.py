@@ -54,6 +54,21 @@ merge).
 No dictionary is built: unlike the dense path (host Arrow
 dictionary_encode) the keys here are the column's own 64-bit values, so
 a 1B-row id column never materializes a host-side distinct set at all.
+
+Execution has two forms. The DEFAULT is the one-pass COLLECTOR form
+(``single_collector_spec`` / ``joint_collector_spec``): key extraction
+is packaged as a ``ScanOps`` whose update appends each batch's u64
+keys into a preallocated device-resident buffer at a carried offset,
+so spill plans ride the SAME shared fused scan as the scalar and
+dense-grouping analyzers — a whole mixed suite costs one traversal of
+the source, and the per-plan sort + segment-count finalizes are
+dispatched async afterwards so they overlap on device. The older
+per-plan form (``device_spill_frequencies`` /
+``device_spill_joint_frequencies``, a full re-read of the source per
+plan) remains as the ``one_pass_spill=False`` escape hatch, the
+fallback when the shared scan fails, and the differential-test oracle;
+both forms produce bit-identical metrics (same batches, same order,
+same pow2 sentinel padding in front of the same sort).
 """
 
 from __future__ import annotations
@@ -66,7 +81,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
-from deequ_tpu.data.table import ColumnRequest, Dataset, Kind, ROW_MASK
+from deequ_tpu.data.table import (
+    ColumnRequest,
+    Dataset,
+    Kind,
+    ROW_MASK,
+    f64_canonical_u64_bits,
+)
 
 # an INTEGRAL column whose (max - min) spans less than this stays on
 # the dense fused-scan path: its host dictionary is bounded by the
@@ -195,20 +216,10 @@ def _joint_chunk_key2_fn(n1: int, n2: int):
     return jax.jit(build)
 
 
-def f64_canonical_bits(values: np.ndarray) -> np.ndarray:
-    """HOST twin of the f64 key canonicalization in _chunk_key_fn, for
-    backends whose X64 rewriter cannot lower the f64->u64 bitcast
-    (TPU; see module docstring): canonical NaN bits, -0.0 remapped to
-    0 — bit-identical to the CPU device path's keys."""
-    bits = (
-        np.ascontiguousarray(values, dtype=np.float64)
-        .view(np.uint64)
-        .copy()
-    )
-    x = np.asarray(values, dtype=np.float64)
-    bits[np.isnan(x)] = np.uint64(0x7FF8000000000000)
-    bits[bits == np.uint64(0x8000000000000000)] = np.uint64(0)
-    return bits
+# HOST twin of the f64 key canonicalization in _chunk_key_fn; now
+# lives in data.table (it backs the "u64bits" column repr the one-pass
+# collector requests), re-exported here for its historical callers
+f64_canonical_bits = f64_canonical_u64_bits
 
 
 @functools.lru_cache(maxsize=None)
@@ -371,6 +382,15 @@ def _pack_top_pairs(pairs, k: int, null_rows: int):
     return keys_out, np.asarray([p[1] for p in pairs], dtype=np.int64)
 
 
+def _count_data_pass() -> None:
+    """Every full traversal of the source bumps ``engine.data_passes``
+    (run_scan counts its own) — the deferred re-scan paths below each
+    cost one; the collector form costs zero beyond the shared scan."""
+    from deequ_tpu.telemetry import get_telemetry
+
+    get_telemetry().counter("engine.data_passes").inc()
+
+
 class SpillOverflow(Exception):
     """A sharded spill bucket exceeded its static capacity; the caller
     falls back to the host Arrow path (exactness over speed)."""
@@ -421,7 +441,7 @@ def _sharded_spill_fn(mesh, axis: str, cap: int):
     a scalar; the host falls back to the Arrow path rather than
     dropping rows."""
     import jax
-    from jax import shard_map
+    from deequ_tpu.engine.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ndev = mesh.shape[axis]
@@ -564,7 +584,7 @@ def _sharded_spill2_fn(mesh, axis: str, cap: int):
     sort (_segment_count_lanes) the single-device path uses. Joint
     codes never reach the sentinel, so legit_max degenerates to 0."""
     import jax
-    from jax import shard_map
+    from deequ_tpu.engine.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ndev = mesh.shape[axis]
@@ -1124,6 +1144,7 @@ def _stage_mesh_columns(dataset, engine, needed, extra_arrays=None):
     mesh, axis = engine.mesh, engine.dp_axis
     ndev = mesh.shape[axis]
     n = dataset.num_rows
+    _count_data_pass()  # materializes every needed column: one pass
     pow2 = 1 << max(1, int(max(n, 1) - 1).bit_length())
     padded = max(1, -(-pow2 // ndev)) * ndev
     sharding = NamedSharding(mesh, P(axis))
@@ -1299,6 +1320,7 @@ def device_spill_joint_frequencies(
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
     chunk_batches = min(CHUNK_BATCHES, nb)
+    _count_data_pass()  # deferred re-scan: one traversal per plan
     split = split_joint_lanes(tuple(sizes))
     if split is None:  # planner should have gated; double-check
         raise SpillOverflow("joint key space exceeds two u64 lanes")
@@ -1425,6 +1447,7 @@ def device_spill_frequencies(
     batch_size = engine._resolve_batch_size(dataset.num_rows)
     nb = dataset.num_batches(batch_size)
     chunk_batches = min(CHUNK_BATCHES, nb)
+    _count_data_pass()  # deferred re-scan: one traversal per plan
 
     if host_f64:
         # u64 keys packed on the HOST (host_f64_u64_keys; the TPU X64
@@ -1579,6 +1602,416 @@ def _sharded_spill_frequencies(
     )
     state._dev = (g_keys, g_counts, segs_host)
     return state
+
+
+# --------------------------------------------------------------------------
+# one-pass collectors: spill key extraction riding the SHARED fused scan
+# --------------------------------------------------------------------------
+
+
+class CollectorSpec:
+    """One spill plan's ride on the shared fused scan.
+
+    ``requests`` + ``ops`` slot into ``engine.run_scan`` next to the
+    scalar/dense ops; the ops' state is the device-resident key buffer
+    (``ScanOps.device_result`` keeps it out of the epilogue fetch).
+    After the scan, ``dispatch(final_state)`` launches this plan's
+    sort + segment-count finalize ASYNC and returns
+    ``(pending, build)``: the caller dispatches EVERY plan first —
+    overlapping the per-plan sorts on device — then fetches all
+    pendings in one packed transfer and calls ``build(fetched)`` to
+    construct the FrequenciesAndNumRows state. ``build`` may raise
+    :class:`SpillOverflow` (sharded hash bucket past capacity); the
+    planner attaches ``overflow_fallback`` (host Arrow) and
+    ``scan_fallback`` (the deferred per-plan re-scan, for when the
+    shared scan itself fails) plus ``on_success`` telemetry."""
+
+    def __init__(self, plan, requests, ops, path, dispatch):
+        self.plan = plan
+        self.requests = list(requests)
+        self.ops = ops
+        self.path = path  # telemetry label ("device-sort"[-joint])
+        self._dispatch = dispatch
+        # wired by the planner (grouping.plan_frequency_passes)
+        self.on_success = lambda: None
+        self.overflow_fallback = None
+        self.scan_fallback = None
+
+    def dispatch(self, state):
+        return self._dispatch(state)
+
+
+def _pow2_len(n: int) -> int:
+    """The key-vector padding rule the deferred path uses (pad to pow2
+    so the expensive-to-compile sort program is shared across datasets
+    whose row counts round the same way) — collector buffers MUST use
+    the identical rule for bit-identical finalize inputs."""
+    return 1 << max(1, int(n - 1).bit_length()) if n > 1 else 1
+
+
+def _collector_geometry(dataset: Dataset, engine):
+    """(mesh, axis, ndev, local_cap): buffer geometry for a collector.
+
+    ``local_cap`` is the pow2-padded per-device key capacity derived
+    from the shared scan's exact row feed (``engine.scan_row_capacity``
+    — every batch row including the zero-padded tail lands in the
+    buffer; padding rows key to the sentinel like any dropped row).
+    Single-device this equals the deferred path's padded key length
+    exactly; under a mesh it matches _stage_mesh_columns' per-shard
+    ``m_local`` whenever the default batch geometry is in effect."""
+    capacity = engine.scan_row_capacity(dataset)
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None:
+        return None, None, 1, _pow2_len(capacity)
+    axis = engine.dp_axis
+    ndev = mesh.shape[axis]
+    # batch_size is rounded to an ndev multiple, so this divides evenly
+    return mesh, axis, ndev, _pow2_len(max(1, capacity // ndev))
+
+
+def _mesh_bucket_cap(m_local: int, ndev: int) -> int:
+    """The sharded shuffle's per-(sender, bucket) capacity — the SAME
+    formula as _stage_mesh_columns so compiled shuffle programs are
+    shared between the collector and deferred forms."""
+    return 1 << max(8, ((4 * m_local) // ndev - 1).bit_length())
+
+
+def _collector_ops(batch_keys, mesh, axis, ndev, local_cap, n_lanes,
+                   cache_token):
+    """Build the collector ``ScanOps``: state is ``(buffers, offset,
+    n_sentinel, n_null)`` where each buffer is a sentinel-filled u64
+    key lane — flat ``(local_cap,)`` single-device, or
+    ``(ndev, local_cap)`` sharded ``P(axis, None)`` under a mesh so
+    each shard appends its own rows and the dynamic write offset lives
+    on the replicated dim. ``batch_keys(batch, consts)`` -> (lanes
+    tuple, n_sentinel, n_null) per batch; every batch appends exactly
+    its row count, so the final offset is statically full — unwritten
+    pow2-padding slots stay sentinel and are added to the correction
+    at dispatch time, exactly like the deferred path's explicit pad."""
+    from deequ_tpu.analyzers.base import ScanOps
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(axis, None))
+
+        def make_buffer():
+            return jax.device_put(
+                jnp.full((ndev, local_cap), _SENTINEL, dtype=jnp.uint64),
+                sharding,
+            )
+    else:
+
+        def make_buffer():
+            return jnp.full(local_cap, _SENTINEL, dtype=jnp.uint64)
+
+    def init():
+        return (
+            tuple(make_buffer() for _ in range(n_lanes)),
+            jnp.int64(0),  # rows written (per shard under a mesh)
+            jnp.int64(0),  # sentinel (non-contributing) rows so far
+            jnp.int64(0),  # null rows kept (include_nulls plans)
+        )
+
+    def update(state, batch, consts=None):
+        buffers, offset, ns, nn = state
+        lanes, s, null = batch_keys(batch, consts)
+        if mesh is not None:
+            written = lanes[0].shape[0] // ndev
+            new_buffers = tuple(
+                jax.lax.dynamic_update_slice(
+                    buf,
+                    lane.reshape(ndev, written),
+                    (jnp.int32(0), offset.astype(jnp.int32)),
+                )
+                for buf, lane in zip(buffers, lanes)
+            )
+        else:
+            written = lanes[0].shape[0]
+            new_buffers = tuple(
+                jax.lax.dynamic_update_slice(
+                    buf, lane, (offset.astype(jnp.int32),)
+                )
+                for buf, lane in zip(buffers, lanes)
+            )
+        return (new_buffers, offset + written, ns + s, nn + null)
+
+    def merge(a, b):
+        raise NotImplementedError(
+            "collector states accumulate through ONE shared scan; "
+            "they never merge across scans"
+        )
+
+    return ScanOps(
+        init, update, merge, cache_token=cache_token, device_result=True
+    )
+
+
+def single_collector_spec(
+    dataset: Dataset, plan, engine
+) -> "CollectorSpec":
+    """The one-pass twin of device_spill_frequencies: a CollectorSpec
+    whose ops accumulate the single grouping column's u64 keys through
+    the shared scan, and whose dispatch runs the identical finalize
+    (single-device sort or sharded shuffle) over the buffer."""
+    import jax as _jax
+    from deequ_tpu.sql.predicate import compile_predicate
+
+    column = plan.columns[0]
+    values_dtype = dataset.request_dtype(ColumnRequest(column, "values"))
+    if values_dtype.kind != "f":
+        key_kind = "int"
+    elif np.dtype(values_dtype).itemsize == 8:
+        key_kind = "f64"
+    else:
+        key_kind = "f32"
+    include_nulls = bool(plan.include_nulls)
+    # f64 on backends whose X64 rewriter can't lower the bitcast (TPU):
+    # the canonical u64 bits pack on the HOST as the "u64bits" column
+    # repr and ride the normal batch pipeline — still one pass
+    host_bits = key_kind == "f64" and (
+        _jax.default_backend() != "cpu" or _FORCE_HOST_F64_BITS
+    )
+    value_req = ColumnRequest(column, "u64bits" if host_bits else "values")
+    requests = [value_req, ColumnRequest(column, "mask")]
+    pred = None
+    if plan.where is not None:
+        pred = compile_predicate(plan.where, dataset)
+        requests += list(pred.requests)
+
+    mesh, axis, ndev, local_cap = _collector_geometry(dataset, engine)
+    key_fn = None if host_bits else _chunk_key_fn(key_kind, include_nulls)
+
+    def batch_keys(batch, _consts):
+        rows = batch[ROW_MASK]
+        if pred is not None:
+            rows = rows & pred.complies(batch)
+        if host_bits:
+            k, s, null = _finish_keys(
+                batch[value_req.key], batch[f"{column}::mask"], rows,
+                include_nulls,
+            )
+        else:
+            k, s, null = key_fn(
+                batch[value_req.key], batch[f"{column}::mask"], rows
+            )
+        return (k,), s, null
+
+    token = None
+    if pred is None or getattr(pred, "dataset_independent", False):
+        token = (
+            "spill-collector", (column,), key_kind, host_bits,
+            include_nulls, plan.where, local_cap, ndev,
+        )
+    ops = _collector_ops(
+        batch_keys, mesh, axis, ndev, local_cap, 1, token
+    )
+
+    if mesh is None:
+
+        def dispatch(state):
+            (buf,), off, ns, nn = state
+            # unwritten pow2 tail slots hold the sentinel from init
+            ns_total = ns + (jnp.int64(local_cap) - off)
+            scalars, group_keys, counts = _finalize_fn()(buf, ns_total)
+
+            def build(fetched):
+                scalars_h, n_null_h = fetched
+                return DeviceFrequencies(
+                    plan.columns, values_dtype, scalars_h, group_keys,
+                    counts, int(n_null_h), include_nulls,
+                )
+
+            return (scalars, nn), build
+
+    else:
+        cap = _mesh_bucket_cap(local_cap, ndev)
+
+        def dispatch(state):
+            (buf,), off, ns, nn = state
+            # per-shard unwritten slots x ndev shards
+            ns_total = ns + (jnp.int64(ndev * local_cap) - off * ndev)
+            out = _sharded_spill_fn(mesh, axis, cap)(
+                buf.reshape(-1), ns_total, nn
+            )
+            scalars, g_keys, g_counts, g_segs, overflow, n_null_g = out
+
+            def build(fetched):
+                scalars_h, overflow_h, n_null_h, segs_h = fetched
+                if int(overflow_h) > 0:
+                    raise SpillOverflow(
+                        f"hash bucket exceeded capacity {cap} on "
+                        f"{column!r}"
+                    )
+                st = ShardedDeviceFrequencies(
+                    plan.columns, values_dtype, scalars_h, g_keys,
+                    g_counts, int(n_null_h), include_nulls,
+                )
+                st._dev = (g_keys, g_counts, segs_h)
+                return st
+
+            return (scalars, overflow, n_null_g, g_segs), build
+
+    return CollectorSpec(plan, requests, ops, "device-sort", dispatch)
+
+
+def joint_collector_spec(
+    dataset: Dataset, plan, engine, dictionaries, sizes
+) -> "CollectorSpec":
+    """The one-pass twin of device_spill_joint_frequencies: joint
+    mixed-radix codes on one u64 lane (or two past 2^62) accumulate
+    through the shared scan; dispatch runs the matching finalize."""
+    from deequ_tpu.sql.predicate import compile_predicate
+
+    columns = list(plan.columns)
+    split = split_joint_lanes(tuple(sizes))
+    if split is None:  # eligibility should have gated; double-check
+        raise SpillOverflow("joint key space exceeds two u64 lanes")
+    two_lane = split < len(columns)
+    requests = [ColumnRequest(c, "codes") for c in columns] + [
+        ColumnRequest(c, "mask") for c in columns
+    ]
+    pred = None
+    if plan.where is not None:
+        pred = compile_predicate(plan.where, dataset)
+        requests += list(pred.requests)
+
+    mesh, axis, ndev, local_cap = _collector_geometry(dataset, engine)
+
+    # per-column radix sizes ride ScanOps.consts (runtime inputs, like
+    # the dense ops' LUTs) so compiled plans stay shareable
+    if two_lane:
+        consts = {
+            "sizes1": np.asarray(sizes[:split], dtype=np.int64),
+            "sizes2": np.asarray(sizes[split:], dtype=np.int64),
+        }
+        key2_fn = _joint_chunk_key2_fn(split, len(columns) - split)
+    else:
+        consts = {"sizes": np.asarray(sizes, dtype=np.int64)}
+        key_fn = _joint_chunk_key_fn(len(columns))
+
+    def batch_keys(batch, c):
+        rows = batch[ROW_MASK]
+        if pred is not None:
+            rows = rows & pred.complies(batch)
+        codes = tuple(batch[f"{col}::codes"] for col in columns)
+        masks = tuple(batch[f"{col}::mask"] for col in columns)
+        if two_lane:
+            k1, k2, s = key2_fn(
+                codes, masks, rows, c["sizes1"], c["sizes2"]
+            )
+            return (k1, k2), s, jnp.int64(0)
+        k, s = key_fn(codes, masks, rows, c["sizes"])
+        return (k,), s, jnp.int64(0)  # no null group (gated)
+
+    token = None
+    if pred is None or getattr(pred, "dataset_independent", False):
+        token = (
+            "spill-collector-joint", tuple(columns), two_lane, split,
+            plan.where, local_cap, ndev,
+        )
+    ops = _collector_ops(
+        batch_keys, mesh, axis, ndev, local_cap,
+        2 if two_lane else 1, token,
+    )
+    ops.consts = consts
+    joint = (list(dictionaries), list(sizes))
+
+    if mesh is None:
+        if two_lane:
+
+            def dispatch(state):
+                (b1, b2), off, ns, _nn = state
+                ns_total = ns + (jnp.int64(local_cap) - off)
+                scalars, g_hi, g_lo, counts = _finalize2_fn()(
+                    b1, b2, ns_total
+                )
+
+                def build(fetched):
+                    return TwoLaneDeviceFrequencies(
+                        plan.columns, fetched, g_hi, g_lo, counts,
+                        joint[0], joint[1], split,
+                    )
+
+                return scalars, build
+
+        else:
+
+            def dispatch(state):
+                (buf,), off, ns, _nn = state
+                ns_total = ns + (jnp.int64(local_cap) - off)
+                scalars, group_keys, counts = _finalize_fn()(
+                    buf, ns_total
+                )
+
+                def build(fetched):
+                    return DeviceFrequencies(
+                        plan.columns, np.dtype(np.int64), fetched,
+                        group_keys, counts, 0, False, joint=joint,
+                    )
+
+                return scalars, build
+
+    else:
+        cap = _mesh_bucket_cap(local_cap, ndev)
+        if two_lane:
+
+            def dispatch(state):
+                (b1, b2), _off, _ns, _nn = state
+                # the 2-lane shuffle drops sentinel rows itself; no
+                # correction scalar enters (matching _sharded_shuffle2)
+                out = _sharded_spill2_fn(mesh, axis, cap)(
+                    b1.reshape(-1), b2.reshape(-1)
+                )
+                scalars, g_hi, g_lo, g_counts, g_segs, overflow = out
+
+                def build(fetched):
+                    scalars_h, overflow_h, segs_h = fetched
+                    if int(overflow_h) > 0:
+                        raise SpillOverflow(
+                            f"hash bucket exceeded capacity {cap} on "
+                            f"joint2 {columns!r}"
+                        )
+                    st = ShardedTwoLaneDeviceFrequencies(
+                        plan.columns, scalars_h, g_hi, g_lo, g_counts,
+                        joint[0], joint[1], split,
+                    )
+                    st._segs = segs_h
+                    return st
+
+                return (scalars, overflow, g_segs), build
+
+        else:
+
+            def dispatch(state):
+                (buf,), off, ns, _nn = state
+                ns_total = ns + (
+                    jnp.int64(ndev * local_cap) - off * ndev
+                )
+                out = _sharded_spill_fn(mesh, axis, cap)(
+                    buf.reshape(-1), ns_total, jnp.int64(0)
+                )
+                scalars, g_keys, g_counts, g_segs, overflow, _nng = out
+
+                def build(fetched):
+                    scalars_h, overflow_h, segs_h = fetched
+                    if int(overflow_h) > 0:
+                        raise SpillOverflow(
+                            f"hash bucket exceeded capacity {cap} on "
+                            f"joint {columns!r}"
+                        )
+                    st = ShardedDeviceFrequencies(
+                        plan.columns, np.dtype(np.int64), scalars_h,
+                        g_keys, g_counts, 0, False, joint=joint,
+                    )
+                    st._dev = (g_keys, g_counts, segs_h)
+                    return st
+
+                return (scalars, overflow, g_segs), build
+
+    return CollectorSpec(
+        plan, requests, ops, "device-sort-joint", dispatch
+    )
 
 
 # --------------------------------------------------------------------------
@@ -1797,6 +2230,7 @@ def multihost_spill_frequencies(
             )
         return host
 
+    _count_data_pass()  # materializes the shard's columns: one pass
     values = pad_to(dataset.materialize(ColumnRequest(column, "values")))
     mask = pad_to(dataset.materialize(ColumnRequest(column, "mask")))
     rows = np.zeros(padded_local, dtype=bool)

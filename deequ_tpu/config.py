@@ -59,6 +59,12 @@ class Options:
     # device sort+segment path for high-cardinality single-numeric-column
     # grouping (analyzers/spill.py); False forces the host Arrow fallback
     device_spill_grouping: bool = True
+    # fold spill key extraction into the shared fused scan (ONE source
+    # traversal for scalars + dense + spill plans, with the per-plan
+    # sort finalizes overlapped); False restores the per-plan deferred
+    # re-scan path — kept for differential testing and as an escape
+    # hatch
+    one_pass_spill: bool = True
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

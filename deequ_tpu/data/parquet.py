@@ -50,8 +50,8 @@ def _column_batch_to_reprs(
     values_dtype: Optional[np.dtype] = None,
 ) -> Dict[str, np.ndarray]:
     """Convert one record-batch column into the requested device reprs.
-    mask/values/lengths share Dataset.materialize's conversion rules
-    (table.convert_basic_repr); codes come from a vectorized
+    mask/values/lengths/u64bits share Dataset.materialize's conversion
+    rules (table.convert_basic_repr); codes come from a vectorized
     ``pc.index_in`` against the dataset-global dictionary (Arrow treats
     NaN as equal to NaN, matching the in-memory dictionary_encode
     path; nulls index to -1). ``values_dtype`` applies the PER-COLUMN

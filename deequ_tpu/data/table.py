@@ -201,7 +201,28 @@ def convert_basic_repr(col, kind: "Kind", repr_name: str) -> np.ndarray:
         return np.ascontiguousarray(
             lengths.to_numpy(zero_copy_only=False).astype(np.int32)
         )
+    if repr_name == "u64bits":
+        return f64_canonical_u64_bits(convert_basic_repr(col, kind, "values"))
     raise ValueError(f"unknown column repr: {repr_name!r}")
+
+
+def f64_canonical_u64_bits(values: np.ndarray) -> np.ndarray:
+    """HOST twin of the f64 spill-key canonicalization in
+    analyzers/spill.py's ``_chunk_key_fn``, for backends whose X64
+    rewriter cannot lower the f64->u64 bitcast on device (TPU):
+    canonical NaN bits, -0.0 remapped to 0 — bit-identical to the CPU
+    device path's keys. Backs the "u64bits" column repr, so the packed
+    bits ride the normal column pipeline (one pass over the source)
+    instead of forcing a separate host re-read per spill plan."""
+    bits = (
+        np.ascontiguousarray(values, dtype=np.float64)
+        .view(np.uint64)
+        .copy()
+    )
+    x = np.asarray(values, dtype=np.float64)
+    bits[np.isnan(x)] = np.uint64(0x7FF8000000000000)
+    bits[bits == np.uint64(0x8000000000000000)] = np.uint64(0)
+    return bits
 
 
 def narrow_int64_values(out: np.ndarray) -> np.ndarray:
@@ -311,7 +332,9 @@ class ColumnRequest:
     """A device representation request: (column, repr)."""
 
     column: str
-    repr: str  # "values" | "mask" | "codes" | "lengths"
+    # "values" | "mask" | "codes" | "lengths" | "u64bits" (host-packed
+    # canonical f64 key bits for the one-pass spill collector)
+    repr: str
 
     @property
     def key(self) -> str:
@@ -495,6 +518,8 @@ class Dataset:
         sources override with their pre-decided per-column dtypes."""
         if req.repr == "mask":
             return np.dtype(bool)
+        if req.repr == "u64bits":
+            return np.dtype(np.uint64)
         return np.dtype(self.materialize(req).dtype)
 
     # -- batching -------------------------------------------------------
@@ -591,6 +616,8 @@ class Dataset:
             return cached.dtype.itemsize  # the true narrowed width
         if r.repr in ("codes", "lengths"):
             return 4
+        if r.repr == "u64bits":
+            return 8
         kind = self._schema.kind_of(r.column)
         if kind in (Kind.BOOLEAN, Kind.STRING):
             return 4

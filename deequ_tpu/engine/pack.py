@@ -24,6 +24,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class DeviceResident:
+    """Marker wrapper for a pytree leaf ``packed_device_get`` must NOT
+    fetch. The fused scan wraps collector op states (device-resident
+    spill key buffers, megabytes of u64 keys) in this before the
+    epilogue fetch: the wrapper is not registered as a pytree node, so
+    it flattens as an opaque leaf and — not being a ``jax.Array`` —
+    passes through the packed transfer untouched. The buffers stay in
+    device memory for the post-scan sort finalize (analyzers/spill.py)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
 def _canonical_dtype_name(dtype) -> str:
     return np.dtype(jax.dtypes.canonicalize_dtype(dtype)).name
 
@@ -106,8 +121,9 @@ def packed_device_get(tree: Any) -> Any:
     jitted program. Runs EAGERLY (ravel + concatenate dispatches, no
     jit): a jitted pack would recompile for every distinct leaf count —
     e.g. a streaming run's pending host-fold outputs scale with the
-    batch count. Host-side leaves (numpy, Python scalars) pass through
-    untouched; only ``jax.Array`` leaves are packed and fetched."""
+    batch count. Host-side leaves (numpy, Python scalars) and
+    :class:`DeviceResident`-wrapped leaves pass through untouched; only
+    bare ``jax.Array`` leaves are packed and fetched."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     device_idx = [
         i for i, leaf in enumerate(leaves) if isinstance(leaf, jax.Array)

@@ -1,0 +1,35 @@
+"""``shard_map`` import/kwarg compatibility.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` and renamed the replication-check kwarg
+``check_rep`` -> ``check_vma`` along the way. Call sites in this repo
+use the new spelling; this shim resolves whichever location the
+installed jax provides and translates the kwarg, so the sharded spill
+and sketch-merge paths work on both old and new builds.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # the long-standing location
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # newer jax: promoted to the top level
+    from jax import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+if "check_vma" in _PARAMS:
+    _CHECK_KWARG = "check_vma"
+elif "check_rep" in _PARAMS:
+    _CHECK_KWARG = "check_rep"
+else:
+    _CHECK_KWARG = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    kwargs = {}
+    if _CHECK_KWARG is not None:
+        kwargs[_CHECK_KWARG] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -1,0 +1,319 @@
+"""One-pass spill (analyzers/spill.py collectors): high-cardinality
+grouping key extraction rides THE shared fused scan instead of one
+deferred re-scan per plan, and every plan's sort finalize dispatches
+before any result is fetched. Ground truth is the deferred per-plan
+re-scan path itself (``one_pass_spill=False``), which these tests
+require to agree EXACTLY — both forms feed byte-identical key vectors
+to the same sort + segment-count programs."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    Completeness,
+    CountDistinct,
+    Distinctness,
+    Histogram,
+    Mean,
+    Size,
+    Uniqueness,
+)
+from deequ_tpu.analyzers import spill as spill_mod
+from deequ_tpu.data import Dataset
+from deequ_tpu.telemetry import get_telemetry
+
+
+class CountingDataset(Dataset):
+    """Dataset that counts every traversal of the source, whichever
+    door the engine walks through (resident chunks, streaming batches,
+    or host record batches)."""
+
+    def __init__(self, table):
+        super().__init__(table)
+        self.traversals = 0
+
+    def device_scan_chunks(self, *args, **kwargs):
+        self.traversals += 1
+        return super().device_scan_chunks(*args, **kwargs)
+
+    def device_batches(self, *args, **kwargs):
+        self.traversals += 1
+        return super().device_batches(*args, **kwargs)
+
+    def record_batches(self, *args, **kwargs):
+        self.traversals += 1
+        return super().record_batches(*args, **kwargs)
+
+
+def _counting(data) -> CountingDataset:
+    return CountingDataset(Dataset.from_pydict(data)._table)
+
+
+def _values(dataset, analyzers, **options):
+    with config.configure(**options):
+        ctx = AnalysisRunner.do_analysis_run(dataset, analyzers)
+    out = {}
+    for a in analyzers:
+        value = ctx.metric(a).value
+        assert value.is_success, (a, value)
+        out[a] = value.get()
+    return out
+
+
+def _assert_one_pass_matches_deferred(data, analyzers):
+    """The load-bearing assertion: same metrics, exactly, both ways."""
+    one = _values(Dataset.from_pydict(data), analyzers, one_pass_spill=True)
+    per = _values(Dataset.from_pydict(data), analyzers, one_pass_spill=False)
+    for a in analyzers:
+        assert one[a] == per[a], (a, one[a], per[a])
+
+
+def _mixed_suite_data(n=50_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        # two independent high-cardinality int spill plans
+        "id_a": rng.integers(0, 2**40, n).tolist(),
+        "id_b": rng.integers(0, 2**40, n).tolist(),
+        # a float spill plan
+        "price": rng.normal(size=n).tolist(),
+        # a dense plan and a scalar column
+        "cat": rng.integers(0, 5, n).tolist(),
+        "x": rng.normal(size=n).tolist(),
+    }
+
+
+MIXED_ANALYZERS = [
+    Size(),
+    Mean("x"),
+    Completeness("price"),
+    Uniqueness(["id_a"]),
+    Distinctness(["id_b"]),
+    CountDistinct(["price"]),
+    Histogram("cat"),
+]
+
+
+class TestSingleTraversal:
+    def test_mixed_suite_traverses_source_exactly_once(self):
+        """Scalars + dense grouping + THREE spill plans = one pass."""
+        ds = _counting(_mixed_suite_data())
+        tm = get_telemetry()
+        before = tm.metrics.snapshot()["counters"].get(
+            "engine.data_passes", 0
+        )
+        with config.configure(one_pass_spill=True):
+            ctx = AnalysisRunner.do_analysis_run(ds, MIXED_ANALYZERS)
+        after = tm.metrics.snapshot()["counters"].get(
+            "engine.data_passes", 0
+        )
+        assert ds.traversals == 1
+        assert after - before == 1
+        for a in MIXED_ANALYZERS:
+            assert ctx.metric(a).value.is_success, a
+
+    def test_deferred_re_scans_per_plan(self):
+        """The escape hatch still costs one extra traversal per spill
+        plan — the behavior the collector form exists to remove."""
+        ds = _counting(_mixed_suite_data())
+        with config.configure(one_pass_spill=False):
+            AnalysisRunner.do_analysis_run(ds, MIXED_ANALYZERS)
+        assert ds.traversals == 4  # shared scan + 3 spill re-reads
+
+    def test_mixed_suite_metrics_identical(self):
+        _assert_one_pass_matches_deferred(
+            _mixed_suite_data(), MIXED_ANALYZERS
+        )
+
+
+class TestDifferentialSingleColumn:
+    def test_int_keys(self):
+        rng = np.random.default_rng(1)
+        data = {"k": rng.integers(-(2**40), 2**40, 30_000).tolist()}
+        _assert_one_pass_matches_deferred(
+            data, [Uniqueness(["k"]), Distinctness(["k"]),
+                   CountDistinct(["k"])]
+        )
+
+    def test_f32_keys(self):
+        rng = np.random.default_rng(2)
+        vals = rng.normal(size=20_000).astype(np.float32)
+        vals[::9] = np.float32(0.0)
+        vals[1::9] = np.float32(-0.0)
+        vals[2::9] = np.float32("nan")
+        data = {"k": vals.tolist()}
+        _assert_one_pass_matches_deferred(
+            data, [Distinctness(["k"]), CountDistinct(["k"])]
+        )
+
+    def test_f64_keys_with_nan_and_signed_zero(self):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=20_000)
+        vals[::7] = np.nan
+        vals[1::11] = 0.0
+        vals[2::13] = -0.0
+        data = {"k": vals.tolist()}
+        _assert_one_pass_matches_deferred(
+            data, [Uniqueness(["k"]), CountDistinct(["k"])]
+        )
+
+    def test_f64_forced_host_bit_packing(self, monkeypatch):
+        """The TPU path: canonical u64 bits packed on the host via the
+        ``u64bits`` column repr instead of a device bitcast."""
+        monkeypatch.setattr(spill_mod, "_FORCE_HOST_F64_BITS", True)
+        rng = np.random.default_rng(4)
+        vals = rng.normal(size=20_000)
+        vals[::7] = np.nan
+        data = {"k": vals.tolist()}
+        tm = get_telemetry()
+        before = tm.metrics.snapshot()["counters"].get(
+            "engine.data_passes", 0
+        )
+        one = _values(
+            Dataset.from_pydict(data),
+            [Size(), Uniqueness(["k"])],
+            one_pass_spill=True,
+        )
+        after = tm.metrics.snapshot()["counters"].get(
+            "engine.data_passes", 0
+        )
+        assert after - before == 1  # host bit packing stays one-pass
+        per = _values(
+            Dataset.from_pydict(data),
+            [Size(), Uniqueness(["k"])],
+            one_pass_spill=False,
+        )
+        assert one == per
+
+    def test_include_nulls_histogram(self):
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=20_000)
+        data = {
+            "k": [
+                None if i % 5 == 0 else float(v)
+                for i, v in enumerate(vals)
+            ]
+        }
+        _assert_one_pass_matches_deferred(
+            data, [Histogram("k", max_detail_bins=25)]
+        )
+
+    def test_where_filter(self):
+        rng = np.random.default_rng(6)
+        data = {
+            "k": rng.normal(size=20_000).tolist(),
+            "gate": rng.integers(0, 2, 20_000).tolist(),
+        }
+        _assert_one_pass_matches_deferred(
+            data, [Uniqueness(["k"], where="gate = 1")]
+        )
+
+
+class TestDifferentialJoint:
+    def test_joint_one_lane(self):
+        rng = np.random.default_rng(7)
+        n = 20_000
+        data = {
+            "a": rng.integers(0, 300, n).tolist(),
+            "b": rng.integers(0, 300, n).tolist(),
+        }
+        analyzers = [Uniqueness(["a", "b"]), Distinctness(["a", "b"])]
+        # force the dense path out: joint ~90k slots > budget
+        one = _values(
+            Dataset.from_pydict(data), analyzers,
+            one_pass_spill=True, dense_grouping_budget_bytes=4 * 1024,
+        )
+        per = _values(
+            Dataset.from_pydict(data), analyzers,
+            one_pass_spill=False, dense_grouping_budget_bytes=4 * 1024,
+        )
+        assert one == per
+
+    def test_joint_two_lanes(self):
+        """Four ~55k-cardinality columns: joint radix product past one
+        u64 lane, keys ride TWO collector lanes."""
+        rng = np.random.default_rng(8)
+        n = 30_000
+        data = {
+            f"c{i}": rng.integers(0, 55_000, n).tolist()
+            for i in range(4)
+        }
+        analyzers = [Uniqueness(["c0", "c1", "c2", "c3"])]
+        one = _values(
+            Dataset.from_pydict(data), analyzers,
+            one_pass_spill=True, dense_grouping_budget_bytes=4 * 1024,
+        )
+        per = _values(
+            Dataset.from_pydict(data), analyzers,
+            one_pass_spill=False, dense_grouping_budget_bytes=4 * 1024,
+        )
+        assert one == per
+
+
+class TestDifferentialMesh:
+    def test_mesh_single_column(self, cpu_mesh):
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(9)
+        n = 40_000
+        data = {
+            "id": rng.integers(0, 2**40, n).tolist(),
+            "x": rng.normal(size=n).tolist(),
+        }
+        analyzers = [Size(), Mean("x"), Uniqueness(["id"]),
+                     CountDistinct(["id"])]
+
+        def run(one_pass):
+            ds = Dataset.from_pydict(data)
+            engine = AnalysisEngine(mesh=cpu_mesh)
+            tm = get_telemetry()
+            before = tm.metrics.snapshot()["counters"].get(
+                "engine.data_passes", 0
+            )
+            with config.configure(one_pass_spill=one_pass):
+                ctx = AnalysisRunner.do_analysis_run(
+                    ds, analyzers, engine=engine
+                )
+            passes = tm.metrics.snapshot()["counters"].get(
+                "engine.data_passes", 0
+            ) - before
+            out = {}
+            for a in analyzers:
+                value = ctx.metric(a).value
+                assert value.is_success, (a, value)
+                out[a] = value.get()
+            return out, passes
+
+        one, p1 = run(True)
+        per, p0 = run(False)
+        assert one == per
+        assert p1 == 1
+        assert p0 == 2  # shared scan + the spill plan's mesh staging
+
+    def test_mesh_joint_two_lanes(self, cpu_mesh):
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        rng = np.random.default_rng(10)
+        n = 30_000
+        data = {
+            f"c{i}": rng.integers(0, 55_000, n).tolist()
+            for i in range(4)
+        }
+        analyzers = [Uniqueness(["c0", "c1", "c2", "c3"])]
+
+        def run(one_pass):
+            ds = Dataset.from_pydict(data)
+            engine = AnalysisEngine(mesh=cpu_mesh)
+            with config.configure(
+                one_pass_spill=one_pass,
+                dense_grouping_budget_bytes=4 * 1024,
+            ):
+                ctx = AnalysisRunner.do_analysis_run(
+                    ds, analyzers, engine=engine
+                )
+            value = ctx.metric(analyzers[0]).value
+            assert value.is_success, value
+            return value.get()
+
+        assert run(True) == run(False)

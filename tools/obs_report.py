@@ -42,6 +42,12 @@ def render_run(summary: Dict[str, Any]) -> str:
     wall = float(summary.get("wall_s", 0.0))
     lines.append(f"run {run_id} ({name}): wall {wall:.3f}s")
 
+    data_passes = summary.get("counters", {}).get("engine.data_passes")
+    if data_passes is not None:
+        # the one-pass-spill headline number: a mixed suite (scalars +
+        # dense grouping + spill plans) should read 1 here
+        lines.append(f"  passes over source: {int(data_passes)}")
+
     passes = summary.get("passes", [])
     if passes:
         lines.append("  passes:")
