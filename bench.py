@@ -593,6 +593,92 @@ def bench_streaming_parquet(num_rows: int, num_cols: int):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_resilience_overhead(num_rows: int = 4_000_000):
+    """Resilience tax on a CLEAN scan (docs/RESILIENCE.md): the same
+    streaming fused-bundle run with retry + periodic checkpointing ON
+    (ScanCheckpointer to local disk, every 2 batches) vs OFF
+    (max_attempts=1, no checkpointer). No faults fire — this prices the
+    bookkeeping alone: per-batch try dispatch, device_get of carried
+    states at each checkpoint, and the pickle+fsync. Reported as pct
+    overhead over the unprotected wall."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.engine.resilience import RetryPolicy
+    from deequ_tpu.engine.scan import AnalysisEngine
+    from deequ_tpu.io.state_provider import ScanCheckpointer
+    from deequ_tpu.telemetry import get_telemetry
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    f"n{i}": rng.normal(0, 1, num_rows).astype(np.float32)
+                    for i in range(10)
+                }
+            )
+        )
+
+    analyzers = []
+    for i in range(10):
+        analyzers += [
+            Mean(f"n{i}"),
+            StandardDeviation(f"n{i}"),
+            Minimum(f"n{i}"),
+            Maximum(f"n{i}"),
+        ]
+    analyzers.append(Compliance("n0 pos", "n0 > 0"))
+
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_ckpt_")
+    try:
+        with config.configure(device_cache_bytes=0, batch_size=1 << 19):
+            AnalysisRunner.do_analysis_run(make(41), analyzers)  # warm
+            fresh = make(42)
+            with config.configure(
+                scan_retry=RetryPolicy(max_attempts=1)
+            ):
+                off_wall, _, _, _ = _timed(
+                    lambda: AnalysisRunner.do_analysis_run(
+                        fresh, analyzers
+                    )
+                )
+            tm = get_telemetry()
+            ckpts_before = tm.counter("engine.checkpoints_written").value
+            with config.configure(checkpoint_every_batches=2):
+                engine = AnalysisEngine(
+                    checkpointer=ScanCheckpointer(workdir)
+                )
+                on_wall, _, _, _ = _timed(
+                    lambda: AnalysisRunner.do_analysis_run(
+                        fresh, analyzers, engine=engine
+                    )
+                )
+            ckpts = tm.counter("engine.checkpoints_written").value
+        return {
+            "unprotected_wall_s": off_wall,
+            "protected_wall_s": on_wall,
+            "checkpoints_written": ckpts - ckpts_before,
+            "overhead_pct": round(
+                100.0 * (on_wall - off_wall) / off_wall, 2
+            ) if off_wall > 0 else 0.0,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _probe_link_mb_per_sec() -> float:
     """The tunnel's host->device bandwidth: the MIN of two 32 MB
     transfers (forced by fetches of a device reduction) — a single
@@ -786,6 +872,8 @@ def main(argv=None):
             ("one_pass_spill_grouping",
              lambda: bench_one_pass_grouping(4_000_000), 100),
             ("sketches_hll_kll", lambda: bench_sketches(8_000_000), 60),
+            ("resilience_overhead",
+             lambda: bench_resilience_overhead(4_000_000), 90),
             ("profiler_50col",
              lambda: bench_profiler_wide(4_000_000, 50), 150),
             ("spill_grouping_12M_distinct",

@@ -77,9 +77,15 @@ from deequ_tpu.analyzers import (  # noqa: E402
     UniqueValueRatio,
 )
 from deequ_tpu.engine import AnalysisEngine  # noqa: E402
+from deequ_tpu.engine.resilience import (  # noqa: E402
+    RetryPolicy,
+    ScanDegradation,
+    TransientScanError,
+)
 from deequ_tpu.io.state_provider import (  # noqa: E402
     FileSystemStateProvider,
     InMemoryStateProvider,
+    ScanCheckpointer,
 )
 from deequ_tpu.profiles.profiler import (  # noqa: E402
     ColumnProfiler,
@@ -187,9 +193,13 @@ __all__ = [
     "RatioOfSums",
     "RelativeRateOfChangeStrategy",
     "ResultKey",
+    "RetryPolicy",
     "RowLevelSchema",
     "RowLevelSchemaValidator",
     "RunMetadata",
+    "ScanCheckpointer",
+    "ScanDegradation",
+    "TransientScanError",
     "SeasonalityModel",
     "profiler_trace",
     "SeriesSeasonality",

@@ -28,6 +28,7 @@ from deequ_tpu.engine.scan import AnalysisEngine
 from deequ_tpu.metrics.metric import Metric
 from deequ_tpu.telemetry import get_telemetry, merge_summaries
 from deequ_tpu.utils.observe import RunMetadata, timed_pass
+from deequ_tpu.utils.trylike import Try
 
 
 # --------------------------------------------------------------------------
@@ -45,6 +46,9 @@ class AnalyzerContext:
     metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
     run_metadata: Optional["RunMetadata"] = None
     telemetry: Optional[Dict[str, Any]] = None
+    # engine.resilience.ScanDegradation when the run's fused scans
+    # quarantined batches (docs/RESILIENCE.md); None = clean run
+    degradation: Optional[Any] = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":
@@ -57,6 +61,8 @@ class AnalyzerContext:
         return self.metric_map.get(analyzer)
 
     def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
+        from deequ_tpu.engine.resilience import ScanDegradation
+
         merged = dict(self.metric_map)
         merged.update(other.metric_map)
         return AnalyzerContext(
@@ -65,6 +71,9 @@ class AnalyzerContext:
                 self.run_metadata, other.run_metadata
             ),
             telemetry=merge_summaries([self.telemetry, other.telemetry]),
+            degradation=ScanDegradation.merge_optional(
+                self.degradation, other.degradation
+            ),
         )
 
     def success_metrics_as_records(
@@ -142,6 +151,9 @@ class AnalysisRunner:
         if not analyzers:
             return AnalyzerContext.empty()
         engine = engine or AnalysisEngine()
+        # fresh degradation record for THIS run; every scan the run
+        # issues (shared pass + deferred fallbacks) merges into it
+        engine.reset_degradation()
         tm = get_telemetry()
         tm.counter("runner.runs").inc()
 
@@ -211,12 +223,17 @@ class AnalysisRunner:
                         )
                     )
 
-            # 6) schema-only analyzers
+            # 6) schema-only analyzers: failure-to-metric conversion via
+            # Try.recover (utils/trylike.py), the reference's idiom —
+            # a raising to_failure_metric would surface as the Failure
             for analyzer in others:
-                try:
-                    metrics[analyzer] = analyzer.compute_directly(data)  # type: ignore[attr-defined]
-                except Exception as exc:  # noqa: BLE001
-                    metrics[analyzer] = analyzer.to_failure_metric(exc)
+                metrics[analyzer] = (
+                    Try.of(
+                        lambda a=analyzer: a.compute_directly(data)  # type: ignore[attr-defined]
+                    )
+                    .recover(analyzer.to_failure_metric)
+                    .get()
+                )
 
         summary = cap.final
         if summary is not None:
@@ -231,8 +248,15 @@ class AnalysisRunner:
         for analyzer, metric in metrics.items():
             tm.analyzer_computed(analyzer, metric)
 
+        degradation = engine.last_degradation
+        if degradation is not None and not degradation.is_degraded:
+            if degradation.retries == 0:
+                degradation = None  # clean run: no record to carry
         context = reused + AnalyzerContext(
-            metrics, run_metadata=metadata, telemetry=summary
+            metrics,
+            run_metadata=metadata,
+            telemetry=summary,
+            degradation=degradation,
         )
 
         # 7) optionally persist to the metrics repository — including

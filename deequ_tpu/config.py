@@ -73,6 +73,23 @@ class Options:
     engine: str = os.environ.get("DEEQU_TPU_ENGINE", "tpu")
     # rows per fused-scan batch when streaming (None = engine default)
     batch_size: Optional[int] = None
+    # per-batch retry policy for the scan's read/decode/transfer stages
+    # (engine/resilience.RetryPolicy; None = the engine's default
+    # policy — 3 attempts, exponential backoff, deterministic jitter).
+    # Set max_attempts=1 to disable retries entirely.
+    scan_retry: Optional[object] = None
+    # how a degraded run (quarantined batches in the fused scan) maps
+    # onto VerificationSuite status: "fail" (the run is Error), "warn"
+    # (at least Warning), "tolerate" (status unchanged; the
+    # degradation record still rides the result)
+    degradation_policy: str = os.environ.get(
+        "DEEQU_TPU_DEGRADATION_POLICY", "fail"
+    )
+    # batches between scan checkpoints when the engine has a
+    # ScanCheckpointer attached (io/state_provider.py); <= 0 disables
+    checkpoint_every_batches: int = int(
+        os.environ.get("DEEQU_TPU_CHECKPOINT_EVERY", 64)
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -134,6 +134,25 @@ class ParquetDataset(Dataset):
         )
         return iter(scanner.to_batches())
 
+    def fingerprint(self) -> str:
+        """STRONG source identity for checkpoint invalidation: the
+        sorted file list plus per-file size and mtime (rewritten,
+        appended or touched files all change it). Falls back to
+        path-only identity for storage without stat support."""
+        import hashlib
+        import os
+
+        h = hashlib.sha1()
+        for path in sorted(self._source.files):
+            h.update(path.encode())
+            try:
+                st = os.stat(path)
+                h.update(f":{st.st_size}:{st.st_mtime_ns}".encode())
+            except OSError:
+                pass
+        h.update(str(self._num_rows).encode())
+        return f"parquet-{h.hexdigest()[:20]}"
+
     # -- statistics from parquet metadata -------------------------------
 
     def _column_null_count(self, column: str) -> int:
@@ -379,15 +398,24 @@ class ParquetDataset(Dataset):
         self,
         requests: Sequence[ColumnRequest],
         batch_size: Optional[int] = None,
+        start_batch: int = 0,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Stream fixed-size batches from the parquet source: read
         column-pruned record batches, convert to device reprs, re-chunk
         to ``batch_size``, zero-pad the tail. Host memory is bounded by
-        O(read_batch + batch_size) per requested repr."""
+        O(read_batch + batch_size) per requested repr.
+
+        ``start_batch`` (resilience-layer retry/resume) skips the first
+        ``start_batch * batch_size`` rows of the stream by slicing the
+        leading record batches away before any conversion; since the
+        skip is a whole number of engine batches, the re-chunker's batch
+        boundaries — and therefore every yielded batch — are identical
+        to the corresponding batches of a full stream."""
         n = self.num_rows
         if batch_size is None:
             batch_size = n if n > 0 else 1
         batch_size = max(1, batch_size)
+        skip_rows = start_batch * batch_size
 
         keys = self._dedup_requests(requests)
         by_column: Dict[str, List[str]] = {}
@@ -397,7 +425,7 @@ class ParquetDataset(Dataset):
         if not columns or n == 0:
             # degenerate: no columns requested (e.g. Size only) or empty
             yield from self._empty_or_counting_batches(
-                keys, batch_size, n
+                keys, batch_size, n, skip_rows
             )
             return
         # pre-build dictionaries for code requests (streaming pre-pass)
@@ -450,6 +478,12 @@ class ParquetDataset(Dataset):
             columns=columns, batch_size=self._read_batch_rows
         )
         for record_batch in scanner.to_batches():
+            if skip_rows > 0:
+                if record_batch.num_rows <= skip_rows:
+                    skip_rows -= record_batch.num_rows
+                    continue
+                record_batch = record_batch.slice(skip_rows)
+                skip_rows = 0
             if record_batch.num_rows == 0:
                 continue
             for ci, column_name in enumerate(columns):
@@ -467,9 +501,13 @@ class ParquetDataset(Dataset):
             yield from drain(force_pad=False)
         yield from drain(force_pad=True)
 
-    def _empty_or_counting_batches(self, keys, batch_size: int, n: int):
+    def _empty_or_counting_batches(
+        self, keys, batch_size: int, n: int, skip_rows: int = 0
+    ):
         """No requested columns (Size()-only) or an empty source."""
         if n == 0:
+            if skip_rows > 0:
+                return
             batch: Dict[str, np.ndarray] = {}
             for k, r in keys.items():
                 kind = self._schema.kind_of(r.column)
@@ -491,7 +529,7 @@ class ParquetDataset(Dataset):
             batch[ROW_MASK] = np.zeros((batch_size,), dtype=bool)
             yield batch
             return
-        remaining = n
+        remaining = n - skip_rows
         while remaining > 0:
             width = min(remaining, batch_size)
             row_mask = np.zeros((batch_size,), dtype=bool)

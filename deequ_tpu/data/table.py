@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import hashlib
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -528,12 +529,19 @@ class Dataset:
         self,
         requests: Sequence[ColumnRequest],
         batch_size: Optional[int] = None,
+        start_batch: int = 0,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield fixed-size batches (host numpy; the engine device_puts).
 
         Every batch has identical shapes: the tail batch is zero-padded
         and padding rows have ``__row_mask__ == False``; per-column masks
         are pre-ANDed with the row mask so updates need a single mask.
+
+        ``start_batch`` skips the first N batches — the engine's
+        resilience layer restarts the stream from a failing batch
+        (retry) or a checkpoint cursor (resume); batch boundaries are
+        identical for every start, so batch ``i`` of a restarted stream
+        is bit-identical to batch ``i`` of a full one.
         """
         n = self.num_rows
         if batch_size is None:
@@ -544,6 +552,8 @@ class Dataset:
             k: self.materialize(r) for k, r in keys.items()
         }
         if n == 0:
+            if start_batch > 0:
+                return
             batch = {
                 k: np.zeros((batch_size,), dtype=v.dtype)
                 for k, v in full.items()
@@ -551,7 +561,7 @@ class Dataset:
             batch[ROW_MASK] = np.zeros((batch_size,), dtype=bool)
             yield batch
             return
-        for start in range(0, n, batch_size):
+        for start in range(start_batch * batch_size, n, batch_size):
             stop = min(start + batch_size, n)
             width = stop - start
             pad = batch_size - width
@@ -807,10 +817,14 @@ class Dataset:
         chunk_batches: int = 1,
         sharding=None,
         budget_bytes: int = 0,
+        start_chunk: int = 0,
     ) -> Iterator[Dict[str, "object"]]:
         """Device-resident stacked batches for the fused ``lax.scan``
         path, yielded chunk by chunk: each chunk is a dict of
-        ``(chunk_batches, batch_size)`` jax arrays.
+        ``(chunk_batches, batch_size)`` jax arrays. ``start_chunk``
+        skips the first N chunks (resilience-layer retry/resume; chunk
+        geometry is independent of the start, so chunk ``i`` is
+        identical whatever chunk the iteration began at).
 
         Chunking is what lets a FRESH-data run overlap transfer with
         compute: ``device_put`` and the per-chunk scan dispatch are both
@@ -887,7 +901,7 @@ class Dataset:
         lut_cache: Dict[str, object] = {}
         pack_masks = sharding is None
 
-        for ci in range(n_chunks):
+        for ci in range(start_chunk, n_chunks):
             start_row = ci * chunk_rows
             rm_key = (ROW_MASK, batch_size, chunk_batches, ci, shard_key)
             if rm_key not in self._device_cache:
@@ -966,3 +980,25 @@ class Dataset:
         if batch_size is None:
             return 1
         return -(-n // batch_size)
+
+    def fingerprint(self) -> str:
+        """Source identity for checkpoint invalidation (resuming a scan
+        against a CHANGED source would silently fold two datasets into
+        one metric). In-memory tables have no stable storage identity,
+        so this is a WEAK fingerprint — schema + row count + a sample
+        of the first column's bytes; parquet sources override with file
+        paths/sizes/mtimes. docs/RESILIENCE.md documents the contract."""
+        h = hashlib.sha1()
+        h.update(
+            repr(
+                [(f.name, f.kind.value) for f in self._schema.fields]
+            ).encode()
+        )
+        h.update(str(self.num_rows).encode())
+        if self.num_rows and len(self._schema):
+            first = self._schema.fields[0].name
+            head = self._table.column(first).slice(
+                0, min(self.num_rows, 1024)
+            )
+            h.update(repr(head.to_pylist()).encode())
+        return f"mem-{h.hexdigest()[:20]}"

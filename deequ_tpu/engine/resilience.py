@@ -1,0 +1,304 @@
+"""Batch-level fault domains for the fused scan.
+
+The reference inherits Spark's task-level fault tolerance for free:
+a lost partition is recomputed from lineage and the aggregation plan
+never notices (SURVEY.md §2.6). deequ_tpu drives its own scan loop, so
+this module supplies the equivalent story at BATCH granularity — each
+source batch is an independent fault domain:
+
+- :class:`RetryPolicy` — configurable per-batch retry with exponential
+  backoff and DETERMINISTIC jitter (seeded hash of (batch, attempt),
+  never ``random``), plus an injectable ``sleep`` so tests run with
+  zero wall-clock delay. ``config.scan_retry`` holds the active policy.
+- transient-vs-deterministic taxonomy — IO/transfer errors
+  (:class:`TransientScanError`, ``OSError`` and its timeout/connection
+  subclasses) are retried; decode/shape errors are not (retrying a
+  deterministic failure just burns the backoff budget).
+- :class:`ScanDegradation` — the provenance record a degraded scan
+  carries: rows skipped, batches quarantined, error classes, one
+  :class:`BatchFailure` per quarantined batch. Threaded through
+  ``AnalyzerContext``/``VerificationResult``; checks map it to
+  fail/warn/tolerate per ``config.degradation_policy``.
+- :func:`resilient_batches` — the driver the engine's scan loops pull
+  from: yields ``(index, item)``, re-creating the source iterator from
+  the failing index on a transient error (generators die on raise, so
+  sources expose ``start_batch``/``start_chunk``) and quarantining a
+  batch that exhausts its attempts or fails deterministically.
+- :class:`ScanKilled` — the fault harness's process-death stand-in.
+  Derives from ``BaseException`` ON PURPOSE: the retry/quarantine
+  machinery catches ``Exception`` only, so a kill unwinds the whole
+  scan exactly like a real SIGKILL would, leaving any checkpoint as
+  the only survivor.
+
+Monoid states make all of this safe: a quarantined batch simply never
+enters the fold, and collector ops (analyzers/spill.py) tolerate the
+skip by construction — their dispatch counts unwritten buffer slots as
+sentinels. See docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class TransientScanError(Exception):
+    """An explicitly-transient source error (flaky IO, throttled reads,
+    transfer hiccups). Raised by sources/wrappers that know the failure
+    is worth retrying; the policy backs off and re-reads the batch."""
+
+
+class BatchIntegrityError(Exception):
+    """A batch arrived structurally wrong (short arrays, layout
+    mismatch). Deterministic by definition — re-reading corrupt data
+    yields corrupt data — so it quarantines immediately, never retries."""
+
+
+class ScanKilled(BaseException):
+    """Deterministic stand-in for process death (kill-at-batch-N in the
+    fault harness). A ``BaseException`` so no ``except Exception`` in
+    the retry/quarantine path can swallow it — the scan unwinds as if
+    the process had died, and only a checkpoint survives."""
+
+
+#: exception types the retry policy treats as transient. TimeoutError
+#: and ConnectionError are OSError subclasses, listed for documentation.
+TRANSIENT_ERROR_TYPES: Tuple[type, ...] = (
+    TransientScanError,
+    OSError,
+    TimeoutError,
+    ConnectionError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    return isinstance(exc, TRANSIENT_ERROR_TYPES)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-batch retry with exponential backoff and deterministic jitter.
+
+    ``delay_s(batch_index, attempt)`` is a pure function of the policy
+    and its arguments — the jitter comes from a seeded hash, never a
+    global RNG — so a retried run is reproducible. ``sleep`` is
+    injectable (tests pass a recorder; None means ``time.sleep``).
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 2.0
+    jitter: float = 0.25  # +/- fraction of the deterministic delay
+    seed: int = 0
+    sleep: Optional[Callable[[float], None]] = None
+
+    def delay_s(self, batch_index: int, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based) of a batch."""
+        base = min(
+            self.backoff_base_s * self.backoff_multiplier ** (attempt - 1),
+            self.backoff_max_s,
+        )
+        if not self.jitter:
+            return base
+        digest = hashlib.blake2b(
+            f"{self.seed}:{batch_index}:{attempt}".encode(), digest_size=8
+        ).digest()
+        frac = int.from_bytes(digest, "big") / 2.0**64  # [0, 1)
+        return base * (1.0 + self.jitter * (2.0 * frac - 1.0))
+
+    def sleep_for(self, seconds: float) -> None:
+        (self.sleep or time.sleep)(seconds)
+
+
+@dataclass
+class BatchFailure:
+    """Provenance for ONE quarantined batch (error objects are reduced
+    to strings so the record pickles into checkpoints and JSON)."""
+
+    batch_index: int
+    rows: int
+    error_class: str
+    message: str
+    attempts: int
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "batch_index": self.batch_index,
+            "rows": self.rows,
+            "error_class": self.error_class,
+            "message": self.message,
+            "attempts": self.attempts,
+        }
+
+
+@dataclass
+class ScanDegradation:
+    """What a degraded scan lost, and why — attached to every run whose
+    fused scan quarantined at least one batch. ``rows_skipped`` is the
+    exact unpadded row count of the quarantined batches, so consumers
+    can bound the metric error (skipped/total rows)."""
+
+    batches_quarantined: int = 0
+    rows_skipped: int = 0
+    retries: int = 0
+    failures: List[BatchFailure] = field(default_factory=list)
+
+    @property
+    def is_degraded(self) -> bool:
+        return self.batches_quarantined > 0
+
+    @property
+    def error_classes(self) -> List[str]:
+        return sorted({f.error_class for f in self.failures})
+
+    def record_quarantine(
+        self, batch_index: int, rows: int, exc: BaseException, attempts: int
+    ) -> None:
+        from deequ_tpu.telemetry import get_telemetry
+
+        self.batches_quarantined += 1
+        self.rows_skipped += int(rows)
+        self.failures.append(
+            BatchFailure(
+                batch_index=int(batch_index),
+                rows=int(rows),
+                error_class=type(exc).__name__,
+                message=str(exc)[:500],
+                attempts=int(attempts),
+            )
+        )
+        tm = get_telemetry()
+        tm.counter("engine.batches_quarantined").inc()
+        tm.event(
+            "batch_quarantined",
+            batch_index=int(batch_index),
+            rows=int(rows),
+            error_class=type(exc).__name__,
+            attempts=int(attempts),
+        )
+
+    def record_retry(self) -> None:
+        from deequ_tpu.telemetry import get_telemetry
+
+        self.retries += 1
+        get_telemetry().counter("engine.batch_retries").inc()
+
+    def merge(self, other: Optional["ScanDegradation"]) -> "ScanDegradation":
+        if other is None:
+            return self
+        return ScanDegradation(
+            batches_quarantined=(
+                self.batches_quarantined + other.batches_quarantined
+            ),
+            rows_skipped=self.rows_skipped + other.rows_skipped,
+            retries=self.retries + other.retries,
+            failures=self.failures + other.failures,
+        )
+
+    @staticmethod
+    def merge_optional(
+        a: Optional["ScanDegradation"], b: Optional["ScanDegradation"]
+    ) -> Optional["ScanDegradation"]:
+        if a is None:
+            return b
+        return a.merge(b)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "batches_quarantined": self.batches_quarantined,
+            "rows_skipped": self.rows_skipped,
+            "retries": self.retries,
+            "error_classes": self.error_classes,
+            "failures": [f.to_dict() for f in self.failures],
+        }
+
+
+def retry_transient(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    batch_index: int,
+    degradation: ScanDegradation,
+):
+    """Run ``fn`` retrying TRANSIENT failures per the policy (used for
+    the in-loop transfer stage, where no iterator restart is needed).
+    Deterministic errors and exhaustion re-raise — the caller decides
+    whether that means quarantine or abort."""
+    attempts = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            attempts += 1
+            if is_transient(exc) and attempts < policy.max_attempts:
+                degradation.record_retry()
+                policy.sleep_for(policy.delay_s(batch_index, attempts))
+                continue
+            raise
+
+
+def resilient_batches(
+    make_iter: Callable[[int], Iterator[Any]],
+    policy: RetryPolicy,
+    degradation: ScanDegradation,
+    rows_for: Callable[[int], int],
+    start: int = 0,
+    validate: Optional[Callable[[Any], None]] = None,
+) -> Iterator[Tuple[int, Any]]:
+    """Yield ``(index, item)`` from ``make_iter(start_index)`` with
+    per-item fault domains.
+
+    A raising generator is DEAD (PEP 342), so retry means re-creating
+    the source iterator from the failing index — ``make_iter`` is a
+    factory over a start index, which the data layer supports via
+    ``start_batch``/``start_chunk``. Failure handling:
+
+    - transient error, attempts remain: back off (deterministic delay,
+      injectable sleep), restart from the same index;
+    - transient exhaustion or deterministic error: quarantine the item
+      (recorded on ``degradation`` with its exact unpadded row count),
+      restart from the next index;
+    - ``validate(item)`` raising: deterministic corruption — quarantine
+      WITHOUT an iterator restart (the source itself is still good);
+    - ``ScanKilled``/``BaseException``: never caught here — unwinds the
+      scan like real process death.
+
+    The failing index is always ``start + items_already_yielded``: the
+    prefetcher's bounded queue is FIFO, so even an error raised on the
+    prefetch thread surfaces in source order.
+    """
+    index = start
+    attempts = 0
+    it = make_iter(index)
+    while True:
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        except Exception as exc:  # noqa: BLE001 — classified below
+            attempts += 1
+            if is_transient(exc) and attempts < policy.max_attempts:
+                degradation.record_retry()
+                policy.sleep_for(policy.delay_s(index, attempts))
+                it = make_iter(index)
+                continue
+            degradation.record_quarantine(
+                index, rows_for(index), exc, attempts
+            )
+            attempts = 0
+            index += 1
+            it = make_iter(index)
+            continue
+        if validate is not None:
+            try:
+                validate(item)
+            except Exception as exc:  # noqa: BLE001 — corruption path
+                degradation.record_quarantine(index, rows_for(index), exc, 1)
+                attempts = 0
+                index += 1
+                continue
+        attempts = 0
+        yield index, item
+        index += 1

@@ -20,6 +20,7 @@ import hashlib
 import json
 import os
 import threading
+from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -177,3 +178,132 @@ def _json_safe(value):
     if isinstance(value, (np.floating, float)):
         return float(value)
     return str(value)
+
+
+# --------------------------------------------------------------------------
+# Scan checkpointing (engine resilience — docs/RESILIENCE.md)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ScanCursor:
+    """Position of a checkpoint inside a scan: ``batch_index`` batches
+    are already folded into the saved states (resume starts there);
+    ``row_offset`` is the source-row high-water mark; the fingerprint
+    pins the SOURCE (a changed source invalidates the checkpoint — the
+    monoid fold would silently mix two datasets otherwise)."""
+
+    batch_index: int
+    row_offset: int
+    source_fingerprint: str
+    batch_size: int
+
+
+class ScanCheckpointer:
+    """Periodic whole-scan checkpoints for the fused scan loop.
+
+    Where :class:`FileSystemStateProvider` persists one FINAL state per
+    analyzer, the checkpointer persists the engine's entire carried
+    state tuple MID-SCAN — every ``checkpoint_every_batches`` batches —
+    together with a :class:`ScanCursor` and the scan's degradation
+    record, so an interrupted scan resumes from the last checkpoint and
+    produces bit-identical metrics (states are monoids; host folds are
+    drained in order before each save, so the fold sequence on resume
+    matches the uninterrupted run).
+
+    Storage routes through :func:`deequ_tpu.io.storage.storage_for`
+    (plain paths, ``file://``, ``mem://``, registered cloud schemes);
+    local writes are temp-file + atomic rename, so a kill mid-save
+    leaves the previous checkpoint intact. The payload is a pickle —
+    analyzer states are numpy pytrees and host accumulators are Python
+    sketch objects; the blob is keyed by a PLAN TOKEN (a digest of the
+    scan's state-tree structure, shapes and dtypes), so a checkpoint
+    can only ever be restored into the plan shape that wrote it, and
+    several concurrent plans can share one checkpoint directory.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        every_batches: Optional[int] = None,
+    ):
+        from deequ_tpu.io.storage import storage_for
+
+        self._path = path
+        self._storage = storage_for(path)
+        # None -> config.checkpoint_every_batches at scan time
+        self.every_batches = every_batches
+
+    def _key(self, plan_token: str) -> str:
+        return f"scan-ckpt-{plan_token}.pkl"
+
+    def interval(self) -> int:
+        """Batches between checkpoints (<= 0 disables)."""
+        if self.every_batches is not None:
+            return int(self.every_batches)
+        from deequ_tpu import config
+
+        return int(config.options().checkpoint_every_batches)
+
+    def save(
+        self,
+        cursor: ScanCursor,
+        plan_token: str,
+        states: Any,
+        host_accs: Dict[int, Any],
+        degradation: Any,
+    ) -> None:
+        import pickle
+
+        payload = {
+            "version": 1,
+            "cursor": cursor,
+            "plan_token": plan_token,
+            "states": states,  # host (numpy) pytrees — device_get'd
+            "host_accs": host_accs,
+            "degradation": degradation,
+        }
+        self._storage.write_bytes(
+            self._key(plan_token),
+            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL),
+        )
+
+    def load(
+        self, source_fingerprint: str, plan_token: str
+    ) -> Optional[Dict[str, Any]]:
+        """The latest checkpoint for this (source, plan), or None when
+        there is none / it belongs to a different source or plan shape /
+        the blob is corrupt (a partial write from a crashed process
+        must degrade to a fresh scan, never abort it)."""
+        import pickle
+
+        raw = self._storage.read_bytes(self._key(plan_token))
+        if raw is None:
+            return None
+        try:
+            payload = pickle.loads(raw)
+        except Exception:  # noqa: BLE001 — corrupt checkpoint = no checkpoint
+            return None
+        if not isinstance(payload, dict) or payload.get("version") != 1:
+            return None
+        cursor = payload.get("cursor")
+        if (
+            not isinstance(cursor, ScanCursor)
+            or cursor.source_fingerprint != source_fingerprint
+            or payload.get("plan_token") != plan_token
+        ):
+            return None
+        return payload
+
+    def clear(self, plan_token: Optional[str] = None) -> None:
+        """Drop checkpoints — the one for ``plan_token``, or every scan
+        checkpoint under the path (a completed scan must not leave a
+        stale cursor for the next run to resume into)."""
+        if plan_token is not None:
+            self._storage.delete(self._key(plan_token))
+            return
+        for key in self._storage.list_keys("scan-ckpt-"):
+            self._storage.delete(key)
+
+    def __repr__(self) -> str:
+        return f"ScanCheckpointer({self._path!r})"

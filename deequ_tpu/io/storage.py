@@ -57,6 +57,11 @@ class Storage:
     def exists(self, key: str) -> bool:
         return self.read_bytes(key) is not None
 
+    def delete(self, key: str) -> None:
+        """Remove a blob; deleting a missing key is a no-op (checkpoint
+        cleanup must be idempotent)."""
+        raise NotImplementedError
+
 
 class LocalStorage(Storage):
     """Plain directory storage (the default)."""
@@ -105,6 +110,12 @@ class LocalStorage(Storage):
     def exists(self, key: str) -> bool:
         return os.path.exists(self._full(key))
 
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._full(key))
+        except FileNotFoundError:
+            pass
+
 
 class MemoryStorage(Storage):
     """In-process storage (``mem://name``): one shared namespace per
@@ -135,6 +146,10 @@ class MemoryStorage(Storage):
     def exists(self, key: str) -> bool:
         with MemoryStorage._lock:
             return key in self._blobs
+
+    def delete(self, key: str) -> None:
+        with MemoryStorage._lock:
+            self._blobs.pop(key, None)
 
 
 _SCHEMES: Dict[str, Callable[[str], Storage]] = {}

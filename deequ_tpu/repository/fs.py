@@ -60,10 +60,21 @@ class FileSystemMetricsRepository(MetricsRepository):
         raw = self._storage.read_bytes(self._key)
         if raw is None:
             return []
-        text = raw.decode()
-        if not text.strip():
+        try:
+            text = raw.decode()
+            if not text.strip():
+                return []
+            return serde.deserialize(text)
+        except Exception:  # noqa: BLE001 — crash-safety: a partial or
+            # corrupt repository file (e.g. from a kill mid-write on a
+            # backend without atomic replace) reads as empty instead of
+            # poisoning every subsequent run; the next save rewrites it
+            from deequ_tpu.telemetry import get_telemetry
+
+            tm = get_telemetry()
+            tm.counter("repository.corrupt_files").inc()
+            tm.event("repository_corrupt_file", path=self._path)
             return []
-        return serde.deserialize(text)
 
     def _write_all(self, results: List[AnalysisResult]) -> None:
         self._storage.write_bytes(

@@ -1,0 +1,6 @@
+"""Deterministic test doubles for the resilience machinery
+(docs/RESILIENCE.md). Not imported by library code — tests only."""
+
+from deequ_tpu.testing.faults import FaultInjectingDataset
+
+__all__ = ["FaultInjectingDataset"]

@@ -1,0 +1,129 @@
+"""Deterministic fault injection for scan resilience tests.
+
+:class:`FaultInjectingDataset` wraps any Dataset and injects faults at
+exact batch indices — every fault is a pure function of the configured
+indices and the wrapper's mutable fault ledger (no RNG, no wall clock),
+so a failing test replays byte-for-byte:
+
+- ``transient={index: n}`` — the batch raises
+  :class:`~deequ_tpu.engine.resilience.TransientScanError` for its
+  first ``n`` reads, then succeeds (raise-then-succeed: the retry path);
+- ``permanent={index, ...}`` — the batch always raises ``ValueError``
+  (a decode error: deterministic, must quarantine without retries);
+- ``corrupt={index, ...}`` — the batch's arrays arrive truncated (the
+  integrity-check path: quarantined, never shipped to the device);
+- ``kill_at_batch=N`` — producing batch N raises
+  :class:`~deequ_tpu.engine.resilience.ScanKilled` (a BaseException:
+  the scan unwinds like real process death, and with ``kill_once`` the
+  next run survives — the checkpoint/resume differential tests).
+
+The fault ledger (remaining transient raises, the kill flag) is SHARED
+across iterator restarts and re-runs of the same wrapper instance,
+mirroring a real flaky source that eventually serves the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, Optional, Set
+
+import numpy as np
+
+from deequ_tpu.engine.resilience import ScanKilled, TransientScanError
+
+
+class FaultInjectingDataset:
+    """Wrap a Dataset, injecting faults at configured batch indices.
+
+    Everything not overridden here (``num_rows``, ``schema``,
+    ``fingerprint``, cache internals, ...) delegates to the inner
+    dataset, so the wrapper is drop-in for the engine's resident,
+    streaming and mesh paths. Fault indices are BATCH indices for
+    ``device_batches`` and CHUNK indices for ``device_scan_chunks``
+    (identical while the engine stacks one batch per chunk).
+    """
+
+    def __init__(
+        self,
+        inner: Any,
+        transient: Optional[Dict[int, int]] = None,
+        permanent: Optional[Iterable[int]] = None,
+        corrupt: Optional[Iterable[int]] = None,
+        kill_at_batch: Optional[int] = None,
+        kill_once: bool = True,
+    ):
+        self._inner = inner
+        self._transient_remaining = dict(transient or {})
+        self._permanent: Set[int] = set(permanent or ())
+        self._corrupt: Set[int] = set(corrupt or ())
+        self._kill_at_batch = kill_at_batch
+        self._kill_once = kill_once
+        self._killed = False
+        # observability for assertions: every fault actually fired
+        self.faults_fired: list = []
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    # -- fault core ----------------------------------------------------
+
+    def _check_faults(self, index: int) -> None:
+        """Raise the configured fault for ``index``, if any — BEFORE the
+        item is yielded, so the engine's failing-index arithmetic
+        (start + items_yielded) lands exactly on ``index``."""
+        if (
+            self._kill_at_batch is not None
+            and index == self._kill_at_batch
+            and not (self._kill_once and self._killed)
+        ):
+            self._killed = True
+            self.faults_fired.append(("kill", index))
+            raise ScanKilled(f"injected kill at batch {index}")
+        if index in self._permanent:
+            self.faults_fired.append(("permanent", index))
+            raise ValueError(f"injected decode error at batch {index}")
+        remaining = self._transient_remaining.get(index, 0)
+        if remaining > 0:
+            self._transient_remaining[index] = remaining - 1
+            self.faults_fired.append(("transient", index))
+            raise TransientScanError(
+                f"injected transient error at batch {index} "
+                f"({remaining - 1} more)"
+            )
+
+    def _maybe_corrupt(self, index: int, batch: Dict[str, Any]):
+        if index not in self._corrupt:
+            return batch
+        self.faults_fired.append(("corrupt", index))
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            out[k] = (
+                arr[: max(arr.shape[0] // 2, 1)] if arr.ndim else arr
+            )
+        return out
+
+    # -- Dataset surface -----------------------------------------------
+
+    def device_batches(
+        self, requests, batch_size: int, start_batch: int = 0
+    ) -> Iterator[Dict[str, Any]]:
+        index = start_batch
+        for batch in self._inner.device_batches(
+            requests, batch_size, start_batch=start_batch
+        ):
+            self._check_faults(index)
+            yield self._maybe_corrupt(index, batch)
+            index += 1
+
+    def device_scan_chunks(
+        self, requests, batch_size: int, start_chunk: int = 0, **kwargs
+    ):
+        # chunk items are device-resident stacks; corruption is a host
+        # concept, so only transient/permanent/kill apply here
+        index = start_chunk
+        for chunk in self._inner.device_scan_chunks(
+            requests, batch_size, start_chunk=start_chunk, **kwargs
+        ):
+            self._check_faults(index)
+            yield chunk
+            index += 1

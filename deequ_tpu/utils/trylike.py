@@ -39,12 +39,34 @@ class Try(Generic[T]):
     def map(self, fn: Callable[[T], U]) -> "Try[U]":
         raise NotImplementedError
 
+    def recover(self, fn: Callable[[BaseException], U]) -> "Try[T | U]":
+        """Scala's ``Try.recover``: a Success passes through; a Failure
+        becomes ``Try.of(lambda: fn(exception))`` — so a raising
+        recovery function is itself a Failure, never an escape."""
+        raise NotImplementedError
+
     @staticmethod
     def of(fn: Callable[[], T]) -> "Try[T]":
         try:
             return Success(fn())
         except Exception as exc:  # noqa: BLE001 — failures-as-values by design
             return Failure(exc)
+
+    @staticmethod
+    def of_retry(fn: Callable[[], T], attempts: int) -> "Try[T]":
+        """``Try.of`` with up to ``attempts`` total tries: re-run ``fn``
+        on any Exception until one succeeds or the budget is spent, then
+        carry the LAST failure. No backoff — callers that need delays
+        use the engine's RetryPolicy; this is the value-level analog for
+        cheap idempotent thunks (repository reads, metric recompute)."""
+        result: Try[T] = Failure(
+            ValueError(f"of_retry needs attempts >= 1, got {attempts}")
+        )
+        for _ in range(max(int(attempts), 0)):
+            result = Try.of(fn)
+            if result.is_success:
+                return result
+        return result
 
 
 class Success(Try[T]):
@@ -62,6 +84,9 @@ class Success(Try[T]):
 
     def map(self, fn: Callable[[T], U]) -> Try[U]:
         return Try.of(lambda: fn(self._value))
+
+    def recover(self, fn: Callable[[BaseException], U]) -> Try[T]:
+        return self
 
     def __repr__(self) -> str:
         return f"Success({self._value!r})"
@@ -92,6 +117,9 @@ class Failure(Try[T]):
 
     def map(self, fn: Callable[[T], U]) -> Try[U]:
         return Failure(self._exception)
+
+    def recover(self, fn: Callable[[BaseException], U]) -> Try[U]:
+        return Try.of(lambda: fn(self._exception))
 
     def __repr__(self) -> str:
         return f"Failure({self._exception!r})"

@@ -44,6 +44,9 @@ class VerificationResult:
         self._data = data  # for row-level results; None on state-only runs
         self.run_metadata = None  # per-pass timings (set by the suite)
         self.telemetry = None  # telemetry run summary (set by the suite)
+        # engine.resilience.ScanDegradation when the run's scans
+        # quarantined batches; None = clean run (set by the suite)
+        self.degradation = None
 
     def row_level_results_as_dataset(
         self,
@@ -176,11 +179,38 @@ class VerificationSuite:
                 key=lambda s: ["Success", "Warning", "Error"].index(s.value),
             )
             status = worst
+        # degraded scans (quarantined batches — docs/RESILIENCE.md):
+        # metrics were computed over PARTIAL data, so the overall status
+        # floors at whatever config.degradation_policy demands — "fail"
+        # (default: partial data is an Error even if every check passed),
+        # "warn" (surface but don't fail), or "tolerate" (status driven
+        # by the checks alone; the record still rides the result)
+        degradation = getattr(context, "degradation", None)
+        if degradation is not None and degradation.is_degraded:
+            from deequ_tpu import config
+
+            policy = config.options().degradation_policy
+            if policy not in ("fail", "warn", "tolerate"):
+                raise ValueError(
+                    f"config.degradation_policy must be 'fail', 'warn' "
+                    f"or 'tolerate', got {policy!r}"
+                )
+            order = ["Success", "Warning", "Error"]
+            floor = {
+                "fail": CheckStatus.ERROR,
+                "warn": CheckStatus.WARNING,
+                "tolerate": status,
+            }[policy]
+            status = max(
+                (status, floor), key=lambda s: order.index(s.value)
+            )
+            tm.counter("checks.degraded_runs").inc()
         result = VerificationResult(
             status, check_results, context.metric_map, data=data
         )
         result.run_metadata = context.run_metadata
         result.telemetry = context.telemetry
+        result.degradation = degradation
         return result
 
 

@@ -1,0 +1,832 @@
+"""Resilient scan execution (docs/RESILIENCE.md): batch-level retry,
+quarantine + graceful degradation, checkpoint/resume, and the
+deterministic fault harness (deequ_tpu/testing/faults.py).
+
+The load-bearing differential: an interrupted-then-resumed scan must
+produce BIT-IDENTICAL metrics to an uninterrupted one, on the resident,
+streaming and mesh paths alike. All faults are seeded/deterministic and
+every retry backoff goes through an injected sleep recorder — no test
+here ever sleeps wall-clock time.
+"""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxQuantile,
+    Completeness,
+    Mean,
+    Size,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.engine.resilience import (
+    BatchIntegrityError,
+    RetryPolicy,
+    ScanDegradation,
+    ScanKilled,
+    TransientScanError,
+    is_transient,
+    resilient_batches,
+    retry_transient,
+)
+from deequ_tpu.engine.scan import AnalysisEngine, _prefetched
+from deequ_tpu.io.state_provider import ScanCheckpointer, ScanCursor
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.testing.faults import FaultInjectingDataset
+from deequ_tpu.utils.trylike import Failure, Success, Try
+from deequ_tpu.verification.suite import VerificationSuite
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+FAST_RETRY = RetryPolicy(max_attempts=3, sleep=_no_sleep)
+
+
+def _table_data(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.normal(size=n).tolist(),
+        "g": (np.arange(n) % 7).tolist(),
+    }
+
+
+ANALYZERS = [
+    Size(),
+    Completeness("a"),
+    Mean("a"),
+    ApproxQuantile("a", 0.5),
+    Uniqueness(["g"]),
+]
+
+
+def _metric_values(ctx, analyzers=ANALYZERS):
+    out = []
+    for a in analyzers:
+        value = ctx.metric(a).value
+        assert value.is_success, (a, value)
+        out.append((str(a), value.get()))
+    return out
+
+
+# mode -> (engine factory, config overrides). Mesh batch sizes round up
+# to a multiple of the 8 virtual devices, so 104 stays 104 everywhere.
+def _mode_setup(mode, cpu_mesh):
+    if mode == "resident":
+        return (lambda **kw: AnalysisEngine(**kw)), dict(
+            device_cache_bytes=1 << 30, batch_size=104
+        )
+    if mode == "streaming":
+        return (lambda **kw: AnalysisEngine(**kw)), dict(
+            device_cache_bytes=0, batch_size=104
+        )
+    assert mode == "mesh"
+    return (lambda **kw: AnalysisEngine(mesh=cpu_mesh, **kw)), dict(
+        device_cache_bytes=0, batch_size=104
+    )
+
+
+MODES = ["resident", "streaming", "mesh"]
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy
+# --------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_delay_is_deterministic_and_jitter_bounded(self):
+        policy = RetryPolicy(
+            backoff_base_s=0.1, backoff_multiplier=2.0, jitter=0.25
+        )
+        for batch in range(5):
+            for attempt in range(1, 4):
+                d1 = policy.delay_s(batch, attempt)
+                d2 = policy.delay_s(batch, attempt)
+                assert d1 == d2  # pure function, seeded jitter
+                base = min(0.1 * 2.0 ** (attempt - 1), policy.backoff_max_s)
+                assert base * 0.75 <= d1 <= base * 1.25
+        # distinct (batch, attempt) pairs actually get distinct jitter
+        delays = {
+            policy.delay_s(b, a) for b in range(5) for a in range(1, 4)
+        }
+        assert len(delays) > 5
+
+    def test_delay_respects_cap(self):
+        policy = RetryPolicy(
+            backoff_base_s=1.0, backoff_max_s=2.0, jitter=0.0
+        )
+        assert policy.delay_s(0, 10) == 2.0
+
+    def test_different_seed_different_jitter(self):
+        a = RetryPolicy(seed=0).delay_s(3, 1)
+        b = RetryPolicy(seed=1).delay_s(3, 1)
+        assert a != b
+
+    def test_sleep_is_injectable(self):
+        recorded = []
+        policy = RetryPolicy(sleep=recorded.append)
+        policy.sleep_for(1234.5)  # would block for 20 min if real
+        assert recorded == [1234.5]
+
+    def test_transient_taxonomy(self):
+        assert is_transient(TransientScanError("x"))
+        assert is_transient(OSError("io"))
+        assert is_transient(TimeoutError("slow"))
+        assert not is_transient(ValueError("decode"))
+        assert not is_transient(BatchIntegrityError("short"))
+
+
+class TestRetryTransient:
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, sleep=sleeps.append)
+        degr = ScanDegradation()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientScanError("hiccup")
+            return "ok"
+
+        assert retry_transient(flaky, policy, 7, degr) == "ok"
+        assert calls["n"] == 3
+        assert degr.retries == 2
+        assert sleeps == [policy.delay_s(7, 1), policy.delay_s(7, 2)]
+
+    def test_deterministic_error_never_retried(self):
+        degr = ScanDegradation()
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("decode error")
+
+        with pytest.raises(ValueError):
+            retry_transient(broken, FAST_RETRY, 0, degr)
+        assert calls["n"] == 1
+        assert degr.retries == 0
+
+    def test_exhaustion_reraises(self):
+        degr = ScanDegradation()
+        with pytest.raises(TransientScanError):
+            retry_transient(
+                lambda: (_ for _ in ()).throw(TransientScanError("x")),
+                FAST_RETRY,
+                0,
+                degr,
+            )
+        assert degr.retries == FAST_RETRY.max_attempts - 1
+
+
+# --------------------------------------------------------------------------
+# Try.recover / Try.of_retry (utils/trylike.py)
+# --------------------------------------------------------------------------
+
+
+class TestTryRecover:
+    def test_success_passes_through(self):
+        assert Success(5).recover(lambda e: 0) == Success(5)
+
+    def test_failure_recovers(self):
+        exc = ValueError("boom")
+        out = Failure(exc).recover(lambda e: f"saw {e}")
+        assert out == Success("saw boom")
+
+    def test_raising_recovery_is_failure(self):
+        def bad(_e):
+            raise KeyError("worse")
+
+        out = Failure(ValueError("boom")).recover(bad)
+        assert out.is_failure
+        assert isinstance(out.exception, KeyError)
+
+    def test_of_retry_succeeds_within_budget(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("not yet")
+            return 42
+
+        assert Try.of_retry(flaky, attempts=5) == Success(42)
+        assert calls["n"] == 3  # stops at first success
+
+    def test_of_retry_keeps_last_failure(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise RuntimeError(f"attempt {calls['n']}")
+
+        out = Try.of_retry(broken, attempts=3)
+        assert calls["n"] == 3
+        assert out.is_failure
+        assert str(out.exception) == "attempt 3"
+
+    def test_of_retry_zero_attempts_is_failure(self):
+        assert Try.of_retry(lambda: 1, attempts=0).is_failure
+
+
+# --------------------------------------------------------------------------
+# _prefetched worker-thread exception propagation
+# --------------------------------------------------------------------------
+
+
+class TestPrefetched:
+    def test_yields_then_raises_original_exception(self):
+        def source():
+            yield 1
+            yield 2
+            raise TransientScanError("read failed mid-stream")
+
+        got = []
+        with pytest.raises(TransientScanError, match="mid-stream") as info:
+            for item in _prefetched(source()):
+                got.append(item)
+        # FIFO: items produced before the failure arrive first — the
+        # engine's failing-index arithmetic depends on this
+        assert got == [1, 2]
+        # the ORIGINAL traceback is attached: the raising frame inside
+        # source() is visible, not just the re-raise site
+        tb = info.value.__traceback__
+        frames = []
+        while tb is not None:
+            frames.append(tb.tb_frame.f_code.co_name)
+            tb = tb.tb_next
+        assert "source" in frames
+
+    def test_clean_iteration_unchanged(self):
+        assert list(_prefetched(iter(range(10)))) == list(range(10))
+
+    def test_immediate_error_propagates(self):
+        def dead():
+            raise OSError("no such source")
+            yield  # pragma: no cover
+
+        with pytest.raises(OSError, match="no such source"):
+            list(_prefetched(dead()))
+
+
+# --------------------------------------------------------------------------
+# resilient_batches driver (unit level)
+# --------------------------------------------------------------------------
+
+
+class TestResilientBatches:
+    def _driver(self, make_iter, validate=None, policy=FAST_RETRY):
+        degr = ScanDegradation()
+        items = list(
+            resilient_batches(
+                make_iter, policy, degr, rows_for=lambda i: 10,
+                validate=validate,
+            )
+        )
+        return items, degr
+
+    def test_transient_restarts_from_failing_index(self):
+        ledger = {"fails_left": 2, "starts": []}
+
+        def make_iter(start):
+            ledger["starts"].append(start)
+
+            def gen():
+                for i in range(start, 6):
+                    if i == 3 and ledger["fails_left"] > 0:
+                        ledger["fails_left"] -= 1
+                        raise TransientScanError("flaky batch 3")
+                    yield f"item{i}"
+
+            return gen()
+
+        items, degr = self._driver(make_iter)
+        assert [i for i, _ in items] == list(range(6))
+        assert [x for _, x in items] == [f"item{i}" for i in range(6)]
+        assert ledger["starts"] == [0, 3, 3]  # restarted AT the failure
+        assert degr.retries == 2
+        assert not degr.is_degraded
+
+    def test_exhaustion_quarantines_and_continues(self):
+        def make_iter(start):
+            def gen():
+                for i in range(start, 5):
+                    if i == 2:
+                        raise TransientScanError("always fails")
+                    yield i
+
+            return gen()
+
+        items, degr = self._driver(make_iter)
+        assert [i for i, _ in items] == [0, 1, 3, 4]
+        assert degr.batches_quarantined == 1
+        assert degr.rows_skipped == 10
+        assert degr.failures[0].batch_index == 2
+        assert degr.failures[0].attempts == FAST_RETRY.max_attempts
+
+    def test_deterministic_error_quarantines_immediately(self):
+        starts = []
+
+        def make_iter(start):
+            starts.append(start)
+
+            def gen():
+                for i in range(start, 4):
+                    if i == 1:
+                        raise ValueError("bad decode")
+                    yield i
+
+            return gen()
+
+        items, degr = self._driver(make_iter)
+        assert [i for i, _ in items] == [0, 2, 3]
+        assert degr.batches_quarantined == 1
+        assert degr.failures[0].attempts == 1
+        assert degr.failures[0].error_class == "ValueError"
+        assert starts == [0, 2]  # no retry restart for deterministic
+
+    def test_validate_quarantines_without_restart(self):
+        starts = []
+
+        def make_iter(start):
+            starts.append(start)
+            return iter(range(start, 5))
+
+        def validate(item):
+            if item == 3:
+                raise BatchIntegrityError("short batch")
+
+        items, degr = self._driver(make_iter, validate=validate)
+        assert [x for _, x in items] == [0, 1, 2, 4]
+        assert degr.batches_quarantined == 1
+        assert starts == [0]  # the source was never restarted
+
+    def test_scan_killed_passes_through(self):
+        def make_iter(start):
+            def gen():
+                yield 0
+                raise ScanKilled("process death")
+
+            return gen()
+
+        degr = ScanDegradation()
+        with pytest.raises(ScanKilled):
+            list(
+                resilient_batches(
+                    make_iter, FAST_RETRY, degr, rows_for=lambda i: 1
+                )
+            )
+        assert not degr.is_degraded  # a kill is not a quarantine
+
+
+class TestScanDegradationRecord:
+    def test_merge(self):
+        a = ScanDegradation()
+        a.record_quarantine(1, 100, ValueError("x"), 1)
+        b = ScanDegradation()
+        b.record_quarantine(5, 50, OSError("y"), 3)
+        b.record_retry()
+        merged = a.merge(b)
+        assert merged.batches_quarantined == 2
+        assert merged.rows_skipped == 150
+        assert merged.retries == 1
+        assert merged.error_classes == ["OSError", "ValueError"]
+        assert ScanDegradation.merge_optional(None, a) is a
+        assert ScanDegradation.merge_optional(a, None) is a
+
+    def test_to_dict_round_trips_failures(self):
+        d = ScanDegradation()
+        d.record_quarantine(2, 10, ValueError("boom"), 2)
+        rec = d.to_dict()
+        assert rec["failures"][0]["batch_index"] == 2
+        assert rec["failures"][0]["message"] == "boom"
+
+
+# --------------------------------------------------------------------------
+# Engine-level: retry / quarantine / checkpoint / resume, all modes
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+class TestEngineResilience:
+    def test_transient_faults_bit_identical(self, mode, cpu_mesh):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        data = _table_data()
+        with config.configure(scan_retry=FAST_RETRY, **opts):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(data), ANALYZERS,
+                    engine=make_engine(),
+                )
+            )
+            tm = get_telemetry()
+            before = tm.counter("engine.batch_retries").value
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(data), transient={2: 1, 5: 2}
+            )
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine()
+            )
+        assert _metric_values(ctx) == ref
+        assert tm.counter("engine.batch_retries").value - before == 3
+        assert ctx.degradation is not None and ctx.degradation.retries == 3
+        assert not ctx.degradation.is_degraded
+
+    def test_permanent_fault_quarantines_and_completes(self, mode, cpu_mesh):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        tm = get_telemetry()
+        before = tm.counter("engine.batches_quarantined").value
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()), permanent={3}
+        )
+        with config.configure(scan_retry=FAST_RETRY, **opts):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine()
+            )
+        degr = ctx.degradation
+        assert degr is not None and degr.is_degraded
+        assert degr.batches_quarantined == 1
+        assert degr.rows_skipped == 104  # one full interior batch
+        assert degr.error_classes == ["ValueError"]
+        assert tm.counter("engine.batches_quarantined").value - before == 1
+        # the scan COMPLETED: every metric computed, over partial data
+        size = ctx.metric(Size()).value.get()
+        assert size == 1000 - 104
+
+    def test_retry_exhaustion_quarantines(self, mode, cpu_mesh):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()), transient={4: 99}
+        )
+        with config.configure(
+            scan_retry=RetryPolicy(max_attempts=2, sleep=_no_sleep), **opts
+        ):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine()
+            )
+        degr = ctx.degradation
+        assert degr.batches_quarantined == 1
+        assert degr.failures[0].error_class == "TransientScanError"
+        assert degr.failures[0].attempts == 2
+
+    def test_kill_then_resume_bit_identical(self, mode, cpu_mesh, tmp_path):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        data = _table_data()
+        tm = get_telemetry()
+        with config.configure(
+            scan_retry=FAST_RETRY, checkpoint_every_batches=3, **opts
+        ):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(data), ANALYZERS,
+                    engine=make_engine(),
+                )
+            )
+            ckpt = ScanCheckpointer(str(tmp_path))
+            engine = make_engine(checkpointer=ckpt)
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(data), kill_at_batch=7
+            )
+            ckpts_before = tm.counter("engine.checkpoints_written").value
+            resumes_before = tm.counter("engine.resumes").value
+            with pytest.raises(ScanKilled):
+                AnalysisRunner.do_analysis_run(ds, ANALYZERS, engine=engine)
+            assert tm.counter("engine.checkpoints_written").value > ckpts_before
+            # a checkpoint survived the kill
+            assert ckpt._storage.list_keys("scan-ckpt-")
+            ctx = AnalysisRunner.do_analysis_run(ds, ANALYZERS, engine=engine)
+            assert tm.counter("engine.resumes").value - resumes_before == 1
+        assert _metric_values(ctx) == ref
+        # completion cleared the checkpoint — nothing stale to resume into
+        assert ckpt._storage.list_keys("scan-ckpt-") == []
+
+    def test_source_fingerprint_invalidates_checkpoint(
+        self, mode, cpu_mesh, tmp_path
+    ):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        tm = get_telemetry()
+        with config.configure(
+            scan_retry=FAST_RETRY, checkpoint_every_batches=3, **opts
+        ):
+            ckpt = ScanCheckpointer(str(tmp_path))
+            engine = make_engine(checkpointer=ckpt)
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(_table_data(seed=0)), kill_at_batch=7
+            )
+            with pytest.raises(ScanKilled):
+                AnalysisRunner.do_analysis_run(ds, ANALYZERS, engine=engine)
+            assert ckpt._storage.list_keys("scan-ckpt-")
+            # a DIFFERENT source must not resume from that checkpoint
+            other = Dataset.from_pydict(_table_data(seed=1))
+            resumes_before = tm.counter("engine.resumes").value
+            ctx = AnalysisRunner.do_analysis_run(
+                other, ANALYZERS, engine=make_engine(checkpointer=ckpt)
+            )
+            assert tm.counter("engine.resumes").value == resumes_before
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(_table_data(seed=1)), ANALYZERS,
+                    engine=make_engine(),
+                )
+            )
+        assert _metric_values(ctx) == ref
+
+
+class TestCorruptBatches:
+    @pytest.mark.parametrize("mode", ["streaming", "mesh"])
+    def test_corrupt_batch_quarantined(self, mode, cpu_mesh):
+        """Both wire formats: the packed path detects corruption inside
+        pack_host_batch, the mesh (non-packed) path via the validate
+        callback — either way the batch is quarantined, not shipped."""
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()), corrupt={1}
+        )
+        with config.configure(scan_retry=FAST_RETRY, **opts):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine()
+            )
+        degr = ctx.degradation
+        assert degr.batches_quarantined == 1
+        assert degr.error_classes == ["BatchIntegrityError"]
+        assert ctx.metric(Size()).value.get() == 1000 - 104
+
+
+class TestMeshSpillResume:
+    """Satellite: kill-at-batch-N then resume stays bit-identical on the
+    mesh path for scalar + dense-grouping + one-pass-spill plans — the
+    checkpoint carries collector key buffers (device-result states) and
+    the structural plan token covers plans the jit cache cannot."""
+
+    @pytest.fixture
+    def spill_data(self):
+        rng = np.random.default_rng(42)
+        n = 4000
+        return {
+            "v": rng.normal(size=n).tolist(),
+            "dense_g": (np.arange(n) % 5).tolist(),
+            "id": rng.integers(0, 2**40, n).tolist(),  # spill plan
+        }
+
+    @pytest.mark.parametrize("one_pass", [True, False])
+    def test_mixed_suite_resume(
+        self, cpu_mesh, tmp_path, spill_data, one_pass
+    ):
+        analyzers = [
+            Size(),
+            Mean("v"),
+            Uniqueness(["dense_g"]),  # dense grouping
+            Uniqueness(["id"]),  # high-cardinality spill
+        ]
+        overrides = dict(
+            device_cache_bytes=0,
+            batch_size=512,
+            scan_retry=FAST_RETRY,
+            checkpoint_every_batches=2,
+            one_pass_spill=one_pass,
+            dense_grouping_budget_bytes=4 * 1024,  # force the spill path
+        )
+        with config.configure(**overrides):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(spill_data), analyzers,
+                    engine=AnalysisEngine(mesh=cpu_mesh),
+                ),
+                analyzers,
+            )
+            engine = AnalysisEngine(
+                mesh=cpu_mesh, checkpointer=ScanCheckpointer(str(tmp_path))
+            )
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(spill_data), kill_at_batch=5
+            )
+            with pytest.raises(ScanKilled):
+                AnalysisRunner.do_analysis_run(ds, analyzers, engine=engine)
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, analyzers, engine=engine
+            )
+        assert _metric_values(ctx, analyzers) == ref
+
+
+# --------------------------------------------------------------------------
+# Degradation -> verification status (config.degradation_policy)
+# --------------------------------------------------------------------------
+
+
+class TestDegradationPolicy:
+    def _degraded_result(self, policy):
+        # checks that PASS on the partial data — status movement below
+        # comes from the degradation floor alone
+        check = (
+            Check(CheckLevel.ERROR, "robust checks")
+            .has_completeness("a", lambda v: v == 1.0)
+            .has_size(lambda s: s > 0)
+        )
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()), permanent={2}
+        )
+        with config.configure(
+            device_cache_bytes=0,
+            batch_size=104,
+            scan_retry=FAST_RETRY,
+            degradation_policy=policy,
+        ):
+            return VerificationSuite.do_verification_run(ds, [check])
+
+    def test_fail_policy_floors_to_error(self):
+        result = self._degraded_result("fail")
+        assert result.status == CheckStatus.ERROR
+        assert result.degradation.batches_quarantined == 1
+
+    def test_warn_policy_floors_to_warning(self):
+        result = self._degraded_result("warn")
+        assert result.status == CheckStatus.WARNING
+        assert result.degradation.is_degraded
+
+    def test_tolerate_policy_keeps_check_status(self):
+        result = self._degraded_result("tolerate")
+        assert result.status == CheckStatus.SUCCESS
+        # the record still rides the result for consumers to inspect
+        assert result.degradation.rows_skipped == 104
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="degradation_policy"):
+            self._degraded_result("yolo")
+
+    def test_clean_run_has_no_degradation(self):
+        check = Check(CheckLevel.ERROR, "ok").has_size(lambda s: s == 1000)
+        with config.configure(device_cache_bytes=0, batch_size=104):
+            result = VerificationSuite.do_verification_run(
+                Dataset.from_pydict(_table_data()), [check]
+            )
+        assert result.status == CheckStatus.SUCCESS
+        assert result.degradation is None
+
+
+# --------------------------------------------------------------------------
+# ScanCheckpointer + storage (io layer)
+# --------------------------------------------------------------------------
+
+
+class TestScanCheckpointer:
+    def _cursor(self, fp="parquet-abc", batch_size=64):
+        return ScanCursor(
+            batch_index=6, row_offset=384,
+            source_fingerprint=fp, batch_size=batch_size,
+        )
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ckpt = ScanCheckpointer(str(tmp_path))
+        states = ({"count": np.int64(7)}, np.arange(4))
+        degr = ScanDegradation()
+        degr.record_quarantine(1, 64, ValueError("x"), 1)
+        ckpt.save(self._cursor(), "tok1", states, {0: [1.0, 2.0]}, degr)
+        payload = ckpt.load("parquet-abc", "tok1")
+        assert payload["cursor"].batch_index == 6
+        assert payload["host_accs"] == {0: [1.0, 2.0]}
+        assert payload["degradation"].batches_quarantined == 1
+        np.testing.assert_array_equal(payload["states"][1], np.arange(4))
+
+    def test_wrong_fingerprint_or_token_is_none(self, tmp_path):
+        ckpt = ScanCheckpointer(str(tmp_path))
+        ckpt.save(self._cursor(), "tok1", (), {}, None)
+        assert ckpt.load("parquet-OTHER", "tok1") is None
+        assert ckpt.load("parquet-abc", "tok2") is None
+
+    def test_corrupt_blob_is_none(self, tmp_path):
+        ckpt = ScanCheckpointer(str(tmp_path))
+        ckpt.save(self._cursor(), "tok1", (), {}, None)
+        key = ckpt._key("tok1")
+        blob = ckpt._storage.read_bytes(key)
+        ckpt._storage.write_bytes(key, blob[: len(blob) // 2])  # partial
+        assert ckpt.load("parquet-abc", "tok1") is None
+        ckpt._storage.write_bytes(key, b"not a pickle at all")
+        assert ckpt.load("parquet-abc", "tok1") is None
+
+    def test_clear(self, tmp_path):
+        ckpt = ScanCheckpointer(str(tmp_path))
+        ckpt.save(self._cursor(), "tok1", (), {}, None)
+        ckpt.save(self._cursor(), "tok2", (), {}, None)
+        ckpt.clear("tok1")
+        assert ckpt.load("parquet-abc", "tok1") is None
+        assert ckpt.load("parquet-abc", "tok2") is not None
+        ckpt.clear()
+        assert ckpt._storage.list_keys("scan-ckpt-") == []
+
+    def test_interval_falls_back_to_config(self, tmp_path):
+        assert ScanCheckpointer(str(tmp_path), every_batches=5).interval() == 5
+        with config.configure(checkpoint_every_batches=17):
+            assert ScanCheckpointer(str(tmp_path)).interval() == 17
+
+    def test_mem_uri_backend(self):
+        ckpt = ScanCheckpointer("mem://ckpt-test")
+        ckpt.save(self._cursor(), "tok1", (), {}, None)
+        assert ckpt.load("parquet-abc", "tok1") is not None
+        ckpt.clear()
+
+
+class TestSourceFingerprints:
+    def test_in_memory_fingerprint_tracks_content(self):
+        a = Dataset.from_pydict({"x": [1.0, 2.0, 3.0]})
+        b = Dataset.from_pydict({"x": [1.0, 2.0, 3.0]})
+        c = Dataset.from_pydict({"x": [1.0, 2.0, 4.0]})
+        assert a.fingerprint() == b.fingerprint()
+        assert a.fingerprint() != c.fingerprint()
+        assert a.fingerprint().startswith("mem-")
+
+    def test_parquet_fingerprint_tracks_files(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data.parquet import ParquetDataset
+
+        path = str(tmp_path / "part.parquet")
+        pq.write_table(pa.table({"x": [1.0, 2.0, 3.0]}), path)
+        fp1 = ParquetDataset(path).fingerprint()
+        assert fp1.startswith("parquet-")
+        assert ParquetDataset(path).fingerprint() == fp1
+        pq.write_table(pa.table({"x": [9.0, 9.0, 9.0, 9.0]}), path)
+        assert ParquetDataset(path).fingerprint() != fp1
+
+
+# --------------------------------------------------------------------------
+# Repository crash-safety (satellite)
+# --------------------------------------------------------------------------
+
+
+class TestRepositoryCorruption:
+    def test_corrupt_file_reads_as_empty_and_recovers(self, tmp_path):
+        from deequ_tpu.repository.base import AnalysisResult, ResultKey
+        from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+        from deequ_tpu.analyzers.runner import AnalyzerContext
+
+        path = tmp_path / "metrics.json"
+        repo = FileSystemMetricsRepository(str(path))
+        key = ResultKey.of(1000, {"env": "test"})
+        ctx = AnalysisRunner.do_analysis_run(
+            Dataset.from_pydict({"x": [1.0, 2.0]}), [Size()]
+        )
+        repo.save(AnalysisResult(key, ctx))
+        assert repo.load_by_key(key) is not None
+
+        # a kill mid-write on a non-atomic backend leaves half a file
+        good = path.read_bytes()
+        path.write_bytes(good[: len(good) // 2])
+        tm = get_telemetry()
+        before = tm.counter("repository.corrupt_files").value
+        assert repo.load_by_key(key) is None  # tolerated, not raised
+        assert tm.counter("repository.corrupt_files").value == before + 1
+
+        # and the next save fully recovers the repository
+        repo.save(AnalysisResult(key, ctx))
+        assert repo.load_by_key(key) is not None
+
+    def test_garbage_bytes_tolerated(self, tmp_path):
+        from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+        path = tmp_path / "metrics.json"
+        path.write_bytes(b"\x00\xff garbage \x80")
+        repo = FileSystemMetricsRepository(str(path))
+        assert repo.load().get() == []
+
+
+# --------------------------------------------------------------------------
+# Telemetry surface: counters exist and obs_report renders them
+# --------------------------------------------------------------------------
+
+
+class TestResilienceTelemetry:
+    def test_obs_report_renders_resilience_section(self, tmp_path):
+        from tools.obs_report import render_run
+
+        tm = get_telemetry()
+        with config.configure(
+            device_cache_bytes=0,
+            batch_size=104,
+            scan_retry=FAST_RETRY,
+            checkpoint_every_batches=3,
+        ):
+            with tm.run("resilience-report") as cap:
+                ds = FaultInjectingDataset(
+                    Dataset.from_pydict(_table_data()),
+                    transient={1: 1},
+                    permanent={4},
+                )
+                engine = AnalysisEngine(
+                    checkpointer=ScanCheckpointer(str(tmp_path))
+                )
+                AnalysisRunner.do_analysis_run(ds, ANALYZERS, engine=engine)
+        summary = cap.final
+        text = render_run(summary)
+        assert "resilience" in text
+        assert "engine.batch_retries" in text
+        assert "engine.batches_quarantined" in text
+        assert "engine.checkpoints_written" in text
+        assert "quarantined batch 4" in text

@@ -72,6 +72,28 @@ def render_run(summary: Dict[str, Any]) -> str:
             if key in phases:
                 lines.append(f"    {key:<14} {phases[key]:8.3f}s")
 
+    res_counters = summary.get("counters", {})
+    res_keys = (
+        "engine.batch_retries",
+        "engine.batches_quarantined",
+        "engine.checkpoints_written",
+        "engine.resumes",
+    )
+    if any(res_counters.get(k) for k in res_keys):
+        lines.append("  resilience:")
+        for k in res_keys:
+            v = res_counters.get(k)
+            if v:
+                lines.append(f"    {k:<32} {int(v)}")
+        for e in summary.get("events", []):
+            if e.get("event") == "batch_quarantined":
+                lines.append(
+                    f"    quarantined batch {e.get('batch_index')}:"
+                    f" {e.get('error_class')}"
+                    f" (rows={e.get('rows')},"
+                    f" attempts={e.get('attempts')})"
+                )
+
     spills = [
         e for e in summary.get("events", [])
         if e.get("event") == "grouping_spill"
