@@ -679,6 +679,73 @@ def bench_resilience_overhead(num_rows: int = 4_000_000):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_watchdog_overhead(num_rows: int = 4_000_000):
+    """Supervision tax on a CLEAN scan (docs/RESILIENCE.md): the same
+    streaming fused-bundle run with a run budget armed (watchdog thread
+    polling, per-batch deadline/stall checks, supervised prefetch queue
+    polls) vs fully unsupervised. No stall or deadline fires — this
+    prices the monitoring alone; the acceptance bar is <2% overhead."""
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.engine.deadline import RunBudget
+    from deequ_tpu.engine.scan import AnalysisEngine
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    f"n{i}": rng.normal(0, 1, num_rows).astype(np.float32)
+                    for i in range(10)
+                }
+            )
+        )
+
+    analyzers = []
+    for i in range(10):
+        analyzers += [
+            Mean(f"n{i}"),
+            StandardDeviation(f"n{i}"),
+            Minimum(f"n{i}"),
+            Maximum(f"n{i}"),
+        ]
+    analyzers.append(Compliance("n0 pos", "n0 > 0"))
+
+    with config.configure(device_cache_bytes=0, batch_size=1 << 19):
+        AnalysisRunner.do_analysis_run(make(41), analyzers)  # warm
+        fresh = make(42)
+        off_wall, _, _, _ = _timed(
+            lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+        )
+        # generous limits: the watchdog is armed and polling but never
+        # fires, so the delta is pure supervision machinery
+        engine = AnalysisEngine(
+            budget=RunBudget(deadline_s=3600.0, stall_s=600.0)
+        )
+        on_wall, _, _, _ = _timed(
+            lambda: AnalysisRunner.do_analysis_run(
+                fresh, analyzers, engine=engine
+            )
+        )
+    return {
+        "unsupervised_wall_s": off_wall,
+        "supervised_wall_s": on_wall,
+        "overhead_pct": round(
+            100.0 * (on_wall - off_wall) / off_wall, 2
+        ) if off_wall > 0 else 0.0,
+    }
+
+
 def _probe_link_mb_per_sec() -> float:
     """The tunnel's host->device bandwidth: the MIN of two 32 MB
     transfers (forced by fetches of a device reduction) — a single
@@ -874,6 +941,8 @@ def main(argv=None):
             ("sketches_hll_kll", lambda: bench_sketches(8_000_000), 60),
             ("resilience_overhead",
              lambda: bench_resilience_overhead(4_000_000), 90),
+            ("watchdog_overhead",
+             lambda: bench_watchdog_overhead(4_000_000), 90),
             ("profiler_50col",
              lambda: bench_profiler_wide(4_000_000, 50), 150),
             ("spill_grouping_12M_distinct",

@@ -77,9 +77,18 @@ from deequ_tpu.analyzers import (  # noqa: E402
     UniqueValueRatio,
 )
 from deequ_tpu.engine import AnalysisEngine  # noqa: E402
+from deequ_tpu.engine.deadline import (  # noqa: E402
+    CancelToken,
+    DeadlineExceeded,
+    RunBudget,
+    RunCancelled,
+    ScanInterruption,
+    install_graceful_shutdown,
+)
 from deequ_tpu.engine.resilience import (  # noqa: E402
     RetryPolicy,
     ScanDegradation,
+    ScanStalled,
     TransientScanError,
 )
 from deequ_tpu.io.state_provider import (  # noqa: E402
@@ -146,6 +155,7 @@ __all__ = [
     "ApproxQuantile",
     "ApproxQuantiles",
     "BatchNormalStrategy",
+    "CancelToken",
     "Check",
     "CheckLevel",
     "CheckStatus",
@@ -163,6 +173,7 @@ __all__ = [
     "DEFAULT_RULES",
     "DataPoint",
     "DataType",
+    "DeadlineExceeded",
     "Dataset",
     "Distinctness",
     "DoubleMetric",
@@ -196,10 +207,15 @@ __all__ = [
     "RetryPolicy",
     "RowLevelSchema",
     "RowLevelSchemaValidator",
+    "RunBudget",
+    "RunCancelled",
     "RunMetadata",
     "ScanCheckpointer",
     "ScanDegradation",
+    "ScanInterruption",
+    "ScanStalled",
     "TransientScanError",
+    "install_graceful_shutdown",
     "SeasonalityModel",
     "profiler_trace",
     "SeriesSeasonality",

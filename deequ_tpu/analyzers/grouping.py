@@ -36,6 +36,7 @@ from deequ_tpu.analyzers.base import (
     Analyzer,
     EmptyStateException,
     GroupingAnalyzer,
+    MetricCalculationException,
     Precondition,
     has_column,
 )
@@ -462,7 +463,7 @@ def finalize_dense_states(
 
 
 def finalize_collector_states(
-    collectors, states, isolate: bool = False
+    collectors, states, isolate: bool = False, cancel=None
 ) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
     """Finish every one-pass spill plan from its shared-scan collector
     state. Dispatch order matters for latency: EVERY plan's sort +
@@ -472,14 +473,31 @@ def finalize_collector_states(
     host-side. ``SpillOverflow`` (sharded hash bucket past capacity)
     takes the plan's host-Arrow fallback. With ``isolate`` set, other
     exceptions become the plan's dict value (the runner's per-plan
-    failure-metric contract) instead of propagating."""
+    failure-metric contract) instead of propagating. A cancelled
+    ``cancel`` token (engine/deadline.py) stops launching new per-plan
+    sorts and skips the fetch — under ``isolate`` each unfinished plan
+    reports the cancellation as its own failure, otherwise
+    ``RunCancelled`` propagates."""
     from deequ_tpu.analyzers.spill import SpillOverflow
+    from deequ_tpu.engine.deadline import RunCancelled
     from deequ_tpu.engine.pack import packed_device_get
+
+    def _cancelled_exc():
+        reason = getattr(cancel, "reason", None) or "cancelled"
+        return RunCancelled(f"spill finalize cancelled: {reason}")
 
     out: Dict[FrequencyPlan, FrequenciesAndNumRows] = {}
     launched = []  # (spec, build) with a slot in the pending tree
     pendings = []
     for spec, state in zip(collectors, states):
+        if cancel is not None and cancel.cancelled:
+            if not isolate:
+                raise _cancelled_exc()
+            out[spec.plan] = MetricCalculationException(
+                "spill finalize skipped: run cancelled "
+                f"({getattr(cancel, 'reason', None) or 'cancelled'})"
+            )
+            continue
         try:
             pending, build = spec.dispatch(state)
         except Exception as exc:  # noqa: BLE001 — finalize trace died;
@@ -493,6 +511,17 @@ def finalize_collector_states(
             continue
         launched.append((spec, build))
         pendings.append(pending)
+    if cancel is not None and cancel.cancelled and launched:
+        # cancelled between dispatch and fetch: don't pay the blocking
+        # device round trip for results nobody will look at
+        if not isolate:
+            raise _cancelled_exc()
+        for spec, _build in launched:
+            out[spec.plan] = MetricCalculationException(
+                "spill finalize skipped: run cancelled "
+                f"({getattr(cancel, 'reason', None) or 'cancelled'})"
+            )
+        return out
     fetched = packed_device_get(tuple(pendings))
     for (spec, build), got in zip(launched, fetched):
         try:
@@ -552,7 +581,11 @@ def compute_many_frequencies(
             events.append({"event": "scan_phases", **engine.phase_times})
         results.update(finalize_dense_states(dense, states[: len(dense)]))
         results.update(
-            finalize_collector_states(collectors, states[len(dense):])
+            finalize_collector_states(
+                collectors,
+                states[len(dense):],
+                cancel=getattr(engine, "cancel", None),
+            )
         )
     return results
 

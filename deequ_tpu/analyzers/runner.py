@@ -49,6 +49,10 @@ class AnalyzerContext:
     # engine.resilience.ScanDegradation when the run's fused scans
     # quarantined batches (docs/RESILIENCE.md); None = clean run
     degradation: Optional[Any] = None
+    # engine.deadline.ScanInterruption when the run was cancelled or
+    # exhausted its deadline mid-scan — metrics cover only the batches
+    # scanned before the interrupt; None = ran to completion
+    interruption: Optional[Any] = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":
@@ -61,6 +65,7 @@ class AnalyzerContext:
         return self.metric_map.get(analyzer)
 
     def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
+        from deequ_tpu.engine.deadline import ScanInterruption
         from deequ_tpu.engine.resilience import ScanDegradation
 
         merged = dict(self.metric_map)
@@ -73,6 +78,9 @@ class AnalyzerContext:
             telemetry=merge_summaries([self.telemetry, other.telemetry]),
             degradation=ScanDegradation.merge_optional(
                 self.degradation, other.degradation
+            ),
+            interruption=ScanInterruption.merge_optional(
+                self.interruption, other.interruption
             ),
         )
 
@@ -146,11 +154,97 @@ class AnalysisRunner:
         reuse_existing_results_for_key=None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key=None,
+        deadline=None,
+        cancel=None,
     ) -> AnalyzerContext:
+        """Run the analysis. ``deadline`` (seconds, or a full
+        ``RunBudget``) and ``cancel`` (a ``CancelToken``) bound the run
+        (docs/RESILIENCE.md): an interrupt mid-scan still RETURNS — a
+        context with partial metrics and ``context.interruption`` set —
+        it never raises. Config fallbacks ``run_deadline_seconds`` /
+        ``batch_stall_seconds`` apply when no explicit envelope is
+        given; ``max_concurrent_runs`` queues runs FIFO, and only a run
+        whose envelope closes while still QUEUED raises
+        (``DeadlineExceeded``/``RunCancelled``) — it never started, so
+        there is nothing partial to return."""
         analyzers = _dedup(analyzers)
         if not analyzers:
             return AnalyzerContext.empty()
         engine = engine or AnalysisEngine()
+
+        from deequ_tpu import config
+        from deequ_tpu.engine.deadline import (
+            RunBudget,
+            admission_controller,
+            shutdown_installed,
+            shutdown_token,
+        )
+
+        opts = config.options()
+        # materialize the run's envelope onto the engine: explicit
+        # params win, then an engine-attached budget/token (left
+        # untouched — the profiler shares ONE across its passes), then
+        # the config knobs; restored in finally so one engine can serve
+        # bounded and unbounded runs interleaved
+        prev_budget, prev_cancel = engine.budget, engine.cancel
+        if deadline is not None:
+            engine.budget = (
+                deadline
+                if isinstance(deadline, RunBudget)
+                else RunBudget(
+                    deadline_s=float(deadline),
+                    stall_s=opts.batch_stall_seconds or None,
+                )
+            )
+        elif engine.budget is None and (
+            opts.run_deadline_seconds > 0 or opts.batch_stall_seconds > 0
+        ):
+            engine.budget = RunBudget(
+                deadline_s=opts.run_deadline_seconds or None,
+                stall_s=opts.batch_stall_seconds or None,
+            )
+        if cancel is not None:
+            engine.cancel = cancel
+
+        admitted = False
+        limit = opts.max_concurrent_runs
+        try:
+            if limit > 0:
+                tokens = [engine.cancel]
+                if shutdown_installed():
+                    tokens.append(shutdown_token())
+                admission_controller().acquire(
+                    limit, budget=engine.budget, tokens=tokens
+                )
+                admitted = True
+            return AnalysisRunner._do_admitted_run(
+                data,
+                analyzers,
+                aggregate_with=aggregate_with,
+                save_states_with=save_states_with,
+                engine=engine,
+                metrics_repository=metrics_repository,
+                reuse_existing_results_for_key=reuse_existing_results_for_key,
+                fail_if_results_missing=fail_if_results_missing,
+                save_or_append_results_with_key=save_or_append_results_with_key,
+            )
+        finally:
+            if admitted:
+                admission_controller().release()
+            engine.budget, engine.cancel = prev_budget, prev_cancel
+
+    @staticmethod
+    def _do_admitted_run(
+        data: Dataset,
+        analyzers: Sequence[Analyzer],
+        aggregate_with=None,
+        save_states_with=None,
+        engine: Optional[AnalysisEngine] = None,
+        metrics_repository=None,
+        reuse_existing_results_for_key=None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key=None,
+    ) -> AnalyzerContext:
         # fresh degradation record for THIS run; every scan the run
         # issues (shared pass + deferred fallbacks) merges into it
         engine.reset_degradation()
@@ -257,6 +351,7 @@ class AnalysisRunner:
             run_metadata=metadata,
             telemetry=summary,
             degradation=degradation,
+            interruption=engine.last_interruption,
         )
 
         # 7) optionally persist to the metrics repository — including
@@ -487,15 +582,28 @@ def _run_fused_pass(
     if states is not None and collectors:
         # dispatch every plan's sort finalize before fetching any
         # result (finalize_collector_states) so the sorts overlap;
-        # isolate: one plan's failure stays its own failure metric
+        # isolate: one plan's failure stays its own failure metric;
+        # the cancel token lets a cancelled run skip the remaining
+        # per-plan device sorts instead of finishing them all
         frequencies.update(
             finalize_collector_states(
                 collectors,
                 states[len(units) + len(dense):],
                 isolate=True,
+                cancel=engine.cancel,
             )
         )
     for plan, run in deferred.items():
+        # an interrupted run must not start ANOTHER pass over the
+        # source — the deferred fallbacks degrade to explicit failure
+        # metrics naming the interrupt instead
+        if engine.last_interruption is not None:
+            frequencies[plan] = MetricCalculationException(
+                f"run {engine.last_interruption.kind} before the "
+                "deferred frequency pass ran: "
+                f"{engine.last_interruption.reason}"
+            )
+            continue
         try:
             frequencies[plan] = run()
         except Exception as exc:  # noqa: BLE001
@@ -531,6 +639,8 @@ class AnalysisRunBuilder:
         self._reuse_key = None
         self._fail_if_results_missing = False
         self._save_key = None
+        self._deadline = None
+        self._cancel = None
 
     def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
         self._analyzers.append(analyzer)
@@ -542,6 +652,17 @@ class AnalysisRunBuilder:
 
     def with_engine(self, engine: AnalysisEngine) -> "AnalysisRunBuilder":
         self._engine = engine
+        return self
+
+    def with_deadline(self, deadline) -> "AnalysisRunBuilder":
+        """Bound the run: seconds (float) or a full ``RunBudget``."""
+        self._deadline = deadline
+        return self
+
+    def with_cancel(self, cancel) -> "AnalysisRunBuilder":
+        """Attach a ``CancelToken`` — cancelling it mid-run exits the
+        scan cleanly with partial metrics + a resumable checkpoint."""
+        self._cancel = cancel
         return self
 
     def aggregate_with(self, state_loader) -> "AnalysisRunBuilder":
@@ -578,4 +699,6 @@ class AnalysisRunBuilder:
             reuse_existing_results_for_key=self._reuse_key,
             fail_if_results_missing=self._fail_if_results_missing,
             save_or_append_results_with_key=self._save_key,
+            deadline=self._deadline,
+            cancel=self._cancel,
         )

@@ -90,6 +90,24 @@ class Options:
     checkpoint_every_batches: int = int(
         os.environ.get("DEEQU_TPU_CHECKPOINT_EVERY", 64)
     )
+    # deadlines & cancellation (engine/deadline.py, docs/RESILIENCE.md):
+    # wall-clock budget for a whole analysis/verification run — on
+    # exhaustion the scan exits cleanly with partial metrics and a
+    # final checkpoint cursor; <= 0 disables
+    run_deadline_seconds: float = float(
+        os.environ.get("DEEQU_TPU_RUN_DEADLINE", 0) or 0
+    )
+    # per-batch stall limit: a batch taking longer than this raises
+    # ScanStalled (transient -> retry -> quarantine); <= 0 disables
+    batch_stall_seconds: float = float(
+        os.environ.get("DEEQU_TPU_BATCH_STALL", 0) or 0
+    )
+    # bounded admission: at most this many concurrent analysis runs in
+    # the process, the rest queue FIFO under their own deadline;
+    # 0 = unlimited
+    max_concurrent_runs: int = int(
+        os.environ.get("DEEQU_TPU_MAX_CONCURRENT_RUNS", 0) or 0
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -1,3 +1,22 @@
+from deequ_tpu.engine.deadline import (
+    CancelToken,
+    DeadlineExceeded,
+    RunBudget,
+    RunCancelled,
+    ScanInterrupted,
+    ScanInterruption,
+    install_graceful_shutdown,
+)
 from deequ_tpu.engine.scan import AnalysisEngine, monoid_all_reduce
 
-__all__ = ["AnalysisEngine", "monoid_all_reduce"]
+__all__ = [
+    "AnalysisEngine",
+    "CancelToken",
+    "DeadlineExceeded",
+    "RunBudget",
+    "RunCancelled",
+    "ScanInterrupted",
+    "ScanInterruption",
+    "install_graceful_shutdown",
+    "monoid_all_reduce",
+]

@@ -63,6 +63,17 @@ class ScanKilled(BaseException):
     the process had died, and only a checkpoint survives."""
 
 
+class ScanStalled(TransientScanError):
+    """A batch exceeded the run's per-batch stall limit
+    (``RunBudget.stall_s``) — raised by the deadline supervisor
+    (``engine/deadline.py``) from whichever stage noticed: the
+    streaming consumer's empty prefetch poll, the iterator's
+    arrival-time check, or a blocked source released by the watchdog
+    thread. A ``TransientScanError`` ON PURPOSE: a stall is retried
+    (the read might succeed the second time) and quarantined when it
+    keeps stalling — the exact PR 3 path, no new machinery."""
+
+
 #: exception types the retry policy treats as transient. TimeoutError
 #: and ConnectionError are OSError subclasses, listed for documentation.
 TRANSIENT_ERROR_TYPES: Tuple[type, ...] = (

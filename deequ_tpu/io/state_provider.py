@@ -263,10 +263,16 @@ class ScanCheckpointer:
             "host_accs": host_accs,
             "degradation": degradation,
         }
-        self._storage.write_bytes(
-            self._key(plan_token),
-            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL),
-        )
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        key = self._key(plan_token)
+        # checkpoints exist to survive crashes, so ask the backend for
+        # power-loss durability (fsync on LocalStorage); a custom
+        # Storage subclass predating the ``durable=`` parameter still
+        # works via the fallback
+        try:
+            self._storage.write_bytes(key, blob, durable=True)
+        except TypeError:
+            self._storage.write_bytes(key, blob)
 
     def load(
         self, source_fingerprint: str, plan_token: str

@@ -34,21 +34,27 @@ after which every state provider / repository accepts ``s3://`` URIs.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 
 class Storage:
     """Byte-blob storage under a base location. Keys are '/'-relative
     names (no scheme); implementations must give ``write_bytes``
     atomic VISIBILITY (a concurrent ``read_bytes``/``list_keys`` sees
-    either the whole blob or nothing)."""
+    either the whole blob or nothing). ``durable=True`` additionally
+    asks for crash DURABILITY: the blob must survive power loss once
+    the call returns (fsync on local disks); backends without a
+    stronger guarantee may ignore it."""
 
     def read_bytes(self, key: str) -> Optional[bytes]:
         raise NotImplementedError
 
-    def write_bytes(self, key: str, data: bytes) -> None:
+    def write_bytes(
+        self, key: str, data: bytes, durable: bool = False
+    ) -> None:
         raise NotImplementedError
 
     def list_keys(self, prefix: str = "") -> List[str]:
@@ -80,14 +86,38 @@ class LocalStorage(Storage):
         except FileNotFoundError:
             return None
 
-    def write_bytes(self, key: str, data: bytes) -> None:
+    def write_bytes(
+        self, key: str, data: bytes, durable: bool = False
+    ) -> None:
         full = self._full(key)
         os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = f"{full}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
             with open(tmp, "wb") as fh:
                 fh.write(data)
+                if durable:
+                    # survive power loss, not just process death: the
+                    # rename below orders only METADATA — without an
+                    # fsync of the data first, a crash can leave the
+                    # new name pointing at zero-length garbage
+                    fh.flush()
+                    os.fsync(fh.fileno())
             os.replace(tmp, full)  # atomic visibility
+            if durable:
+                # the rename itself lives in the directory: fsync it
+                # too, or the replace may not survive the crash. Some
+                # filesystems refuse O_RDONLY directory fsync — treat
+                # that as "as durable as this FS gets", not an error.
+                try:
+                    dir_fd = os.open(
+                        os.path.dirname(full) or ".", os.O_RDONLY
+                    )
+                    try:
+                        os.fsync(dir_fd)
+                    finally:
+                        os.close(dir_fd)
+                except OSError:
+                    pass
         finally:
             if os.path.exists(tmp):  # failed write: no orphan
                 os.unlink(tmp)
@@ -133,7 +163,10 @@ class MemoryStorage(Storage):
         with MemoryStorage._lock:
             return self._blobs.get(key)
 
-    def write_bytes(self, key: str, data: bytes) -> None:
+    def write_bytes(
+        self, key: str, data: bytes, durable: bool = False
+    ) -> None:
+        del durable  # process memory: no stronger guarantee exists
         with MemoryStorage._lock:
             self._blobs[key] = bytes(data)
 
@@ -166,6 +199,32 @@ register_storage_scheme("mem", MemoryStorage)
 register_storage_scheme(
     "file", lambda uri: LocalStorage(uri.split("://", 1)[1])
 )
+
+
+@contextlib.contextmanager
+def interprocess_lock(path: str) -> Iterator[None]:
+    """Cross-process advisory lock via ``fcntl.flock`` on a sidecar
+    lock file (blocks until acquired; released on exit or process
+    death — the kernel drops flocks with the fd). Two PROCESSES doing
+    read-modify-write on a shared repository file serialize through
+    this; a ``threading.Lock`` alone cannot see across fork/exec.
+    No-ops on platforms without ``fcntl`` (Windows), where the
+    in-process lock remains the only guarantee."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover — non-POSIX
+        yield
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)
 
 
 def storage_for(path_or_uri: str) -> Storage:

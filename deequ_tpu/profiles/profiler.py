@@ -83,6 +83,10 @@ class ColumnProfiles:
     num_records: int
     run_metadata: Optional["object"] = None  # utils.observe.RunMetadata
     telemetry: Optional[dict] = None  # merged telemetry run summary
+    # engine.deadline.ScanInterruption when profiling was cancelled or
+    # ran out of deadline — the passes after the interrupt were skipped
+    # and their profile fields are None; None = profiled to completion
+    interruption: Optional[object] = None
 
     def __getitem__(self, column: str) -> StandardColumnProfile:
         return self.profiles[column]
@@ -97,8 +101,26 @@ class ColumnProfiler:
         kll_profiling: bool = False,
         kll_parameters: Optional[KLLParameters] = None,
         engine: Optional[AnalysisEngine] = None,
+        deadline=None,
+        cancel=None,
     ) -> ColumnProfiles:
+        """Profile all columns. ``deadline`` (seconds or a
+        ``RunBudget``) and ``cancel`` (a ``CancelToken``) bound the
+        WHOLE profile — the multi-pass loop shares ONE envelope
+        (``RunBudget.start()`` pins the epoch on first use and is
+        idempotent), so pass 2/3 inherit whatever pass 1 left; a pass
+        interrupted mid-scan ends the loop and the remaining passes are
+        skipped, with the provenance on ``profiles.interruption``."""
         engine = engine or AnalysisEngine()
+        if deadline is not None:
+            from deequ_tpu import config
+            from deequ_tpu.engine.deadline import RunBudget
+
+            if not isinstance(deadline, RunBudget):
+                deadline = RunBudget(
+                    deadline_s=float(deadline),
+                    stall_s=config.options().batch_stall_seconds or None,
+                )
         columns = list(restrict_to_columns or data.schema.column_names)
         for c in columns:
             if not data.schema.has_column(c):
@@ -177,7 +199,10 @@ class ColumnProfiler:
                 pass1.append(DataType(c))
         pass1 += [Histogram(c) for c in pass1_histograms]
         pass1 += numeric_analyzers(numeric_native)
-        ctx1 = AnalysisRunner.do_analysis_run(data, pass1, engine=engine)
+        ctx1 = AnalysisRunner.do_analysis_run(
+            data, pass1, engine=engine, deadline=deadline, cancel=cancel
+        )
+        interruption = ctx1.interruption
 
         num_records = int(ctx1.metric(Size()).value.get_or_else(0.0))
         completeness: Dict[str, float] = {}
@@ -220,13 +245,17 @@ class ColumnProfiler:
         ]
         promoted_ctx = None
         ctx2 = ctx1
-        if numeric_promoted:
+        # an interrupted pass ends the loop: later passes never start
+        # (their scans would each burn a batch discovering the dead
+        # envelope) — the assembled profiles just lack those fields
+        if numeric_promoted and interruption is None:
             promoted_data = _cast_string_columns(data, numeric_promoted)
             promoted_ctx = AnalysisRunner.do_analysis_run(
                 promoted_data, numeric_analyzers(numeric_promoted),
-                engine=engine,
+                engine=engine, deadline=deadline, cancel=cancel,
             )
             ctx2 = ctx1 + promoted_ctx
+            interruption = ctx2.interruption
 
         # ---- PASS 3: histograms for low-cardinality columns ----------
         # (ALL histograms share one scan via compute_many_frequencies;
@@ -242,10 +271,12 @@ class ColumnProfiler:
         pass3_columns = [
             c for c in histogram_columns if c not in pass1_histograms
         ]
-        if pass3_columns:
+        if pass3_columns and interruption is None:
             ctx3 = AnalysisRunner.do_analysis_run(
-                data, [Histogram(c) for c in pass3_columns], engine=engine
+                data, [Histogram(c) for c in pass3_columns],
+                engine=engine, deadline=deadline, cancel=cancel,
             )
+            interruption = ctx3.interruption or interruption
         else:
             ctx3 = AnalyzerContext({})
 
@@ -316,7 +347,7 @@ class ColumnProfiler:
         )
         return ColumnProfiles(
             profiles, num_records, run_metadata=metadata,
-            telemetry=telemetry,
+            telemetry=telemetry, interruption=interruption,
         )
 
 

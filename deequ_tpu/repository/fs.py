@@ -5,17 +5,21 @@ Reference: ``repository/fs/FileSystemMetricsRepository.scala`` (SURVEY.md
 paths use the local filesystem and ``scheme://`` URIs route through
 deequ_tpu.io.storage's backend registry (``mem://`` ships in-tree;
 cloud backends register in a few lines — VERDICT r3 missing #5).
-Concurrent writers are serialized by an advisory in-process lock; the
-file is rewritten with atomic visibility (Storage.write_bytes).
+Concurrent writers are serialized by an advisory in-process lock plus,
+on local filesystems, an ``fcntl.flock`` cross-process lock (two worker
+processes appending to the same repository file would otherwise lose
+updates in the read-modify-write); the file is rewritten with atomic
+visibility (Storage.write_bytes).
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import List, Optional
 
-from deequ_tpu.io.storage import storage_for
+from deequ_tpu.io.storage import LocalStorage, interprocess_lock, storage_for
 from deequ_tpu.repository import base, serde
 from deequ_tpu.repository.base import (
     AnalysisResult,
@@ -56,6 +60,20 @@ class FileSystemMetricsRepository(MetricsRepository):
                 )
             self._storage = storage_for(parent)
 
+    @contextlib.contextmanager
+    def _process_lock(self):
+        """Cross-process flock on local storage (sidecar ``.lock`` file
+        next to the repository file); remote backends rely on their own
+        consistency model, so only the in-process lock applies there."""
+        if isinstance(self._storage, LocalStorage):
+            lock_path = os.path.join(
+                self._storage.root, self._key + ".lock"
+            )
+            with interprocess_lock(lock_path):
+                yield
+        else:
+            yield
+
     def _read_all(self) -> List[AnalysisResult]:
         raw = self._storage.read_bytes(self._key)
         if raw is None:
@@ -83,7 +101,7 @@ class FileSystemMetricsRepository(MetricsRepository):
 
     def save(self, result: AnalysisResult) -> None:
         base._bump("repository.saves")
-        with self._lock:
+        with self._lock, self._process_lock():
             results = [
                 r
                 for r in self._read_all()
@@ -94,7 +112,7 @@ class FileSystemMetricsRepository(MetricsRepository):
 
     def load_by_key(self, key: ResultKey) -> Optional[AnalysisResult]:
         base._bump("repository.loads")
-        with self._lock:
+        with self._lock, self._process_lock():
             for result in self._read_all():
                 if result.result_key == key:
                     return result
@@ -102,5 +120,5 @@ class FileSystemMetricsRepository(MetricsRepository):
 
     def load(self) -> MetricsRepositoryMultipleResultsLoader:
         base._bump("repository.loads")
-        with self._lock:
+        with self._lock, self._process_lock():
             return MetricsRepositoryMultipleResultsLoader(self._read_all())

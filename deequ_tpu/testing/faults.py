@@ -15,20 +15,39 @@ so a failing test replays byte-for-byte:
 - ``kill_at_batch=N`` — producing batch N raises
   :class:`~deequ_tpu.engine.resilience.ScanKilled` (a BaseException:
   the scan unwinds like real process death, and with ``kill_once`` the
-  next run survives — the checkpoint/resume differential tests).
+  next run survives — the checkpoint/resume differential tests);
+- ``hang_at_batch={index: n}`` — producing the batch BLOCKS until the
+  scan supervisor's watchdog interrupts it (the hung-source path: the
+  wrapper spins on the interrupt event the engine attaches via
+  ``attach_interrupt``, advancing the injectable ``clock`` by
+  ``hang_tick_s`` per spin so fake-clock stall detection fires without
+  any real sleeping), then raises
+  :class:`~deequ_tpu.engine.resilience.ScanStalled`; the batch re-hangs
+  ``n`` times (one per retry attempt) before serving normally;
+- ``slow_batch={index: delay_s}`` — producing the batch advances the
+  injectable ``clock`` by ``delay_s`` once (the slow-but-arriving path:
+  stall/deadline detection on a batch that DOES show up);
+- ``on_batch={index: callable}`` — the callable runs every time the
+  batch is produced, before fault checks (the deterministic trigger for
+  cancel-mid-scan tests: cancel a token at exactly batch k).
 
-The fault ledger (remaining transient raises, the kill flag) is SHARED
-across iterator restarts and re-runs of the same wrapper instance,
-mirroring a real flaky source that eventually serves the batch.
+The fault ledger (remaining transient raises, remaining hangs, one-shot
+slow delays, the kill flag) is SHARED across iterator restarts and
+re-runs of the same wrapper instance, mirroring a real flaky source
+that eventually serves the batch.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Iterator, Optional, Set
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Set
 
 import numpy as np
 
-from deequ_tpu.engine.resilience import ScanKilled, TransientScanError
+from deequ_tpu.engine.resilience import (
+    ScanKilled,
+    ScanStalled,
+    TransientScanError,
+)
 
 
 class FaultInjectingDataset:
@@ -50,6 +69,11 @@ class FaultInjectingDataset:
         corrupt: Optional[Iterable[int]] = None,
         kill_at_batch: Optional[int] = None,
         kill_once: bool = True,
+        hang_at_batch: Optional[Any] = None,
+        slow_batch: Optional[Dict[int, float]] = None,
+        on_batch: Optional[Dict[int, Callable[[], None]]] = None,
+        clock: Optional[Any] = None,
+        hang_tick_s: float = 0.25,
     ):
         self._inner = inner
         self._transient_remaining = dict(transient or {})
@@ -58,13 +82,79 @@ class FaultInjectingDataset:
         self._kill_at_batch = kill_at_batch
         self._kill_once = kill_once
         self._killed = False
+        # hang_at_batch accepts {index: n_hangs} or a bare iterable of
+        # indices (one hang each)
+        if hang_at_batch is None:
+            self._hangs_remaining: Dict[int, int] = {}
+        elif isinstance(hang_at_batch, dict):
+            self._hangs_remaining = dict(hang_at_batch)
+        else:
+            self._hangs_remaining = {i: 1 for i in hang_at_batch}
+        self._slow_remaining = dict(slow_batch or {})
+        self._on_batch = dict(on_batch or {})
+        self._clock = clock
+        self._hang_tick_s = float(hang_tick_s)
+        self._interrupt_event: Optional[Any] = None
         # observability for assertions: every fault actually fired
         self.faults_fired: list = []
+
+    def attach_interrupt(self, event: Any) -> None:
+        """Engine protocol hook: the scan supervisor hands the source an
+        Event it will set when the watchdog wants the source unblocked
+        (a fresh one per iterator (re)start)."""
+        self._interrupt_event = event
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._inner, name)
 
     # -- fault core ----------------------------------------------------
+
+    def _fire_hook(self, index: int) -> None:
+        hook = self._on_batch.get(index)
+        if hook is not None:
+            self.faults_fired.append(("hook", index))
+            hook()
+
+    def _maybe_slow(self, index: int) -> None:
+        delay = self._slow_remaining.pop(index, None)
+        if delay is None:
+            return
+        self.faults_fired.append(("slow", index))
+        if self._clock is not None:
+            self._clock.advance(delay)
+
+    def _maybe_hang(self, index: int) -> None:
+        remaining = self._hangs_remaining.get(index, 0)
+        if remaining <= 0:
+            return
+        self._hangs_remaining[index] = remaining - 1
+        self.faults_fired.append(("hang", index))
+        ev = self._interrupt_event
+        if ev is None:
+            # no supervisor armed this source: a real hang would block
+            # forever — self-report the stall instead of deadlocking
+            # the test process
+            if self._clock is not None:
+                self._clock.advance(self._hang_tick_s)
+            raise ScanStalled(
+                f"injected hang at batch {index} (no supervisor attached)"
+            )
+        ticks = 0
+        while not ev.is_set():
+            # the hang is where fake time passes: tick the injectable
+            # clock so the watchdog's stall rule (now - last_progress >
+            # stall_s) trips without any real sleeping, then yield the
+            # GIL briefly so the watchdog thread actually runs
+            if self._clock is not None:
+                self._clock.advance(self._hang_tick_s)
+            ev.wait(0.001)
+            ticks += 1
+            if ticks > 20_000:  # ~20s real: supervision is broken
+                raise RuntimeError(
+                    f"injected hang at batch {index} was never "
+                    "interrupted by the watchdog"
+                )
+        raise ScanStalled(f"injected hang at batch {index} interrupted")
 
     def _check_faults(self, index: int) -> None:
         """Raise the configured fault for ``index``, if any — BEFORE the
@@ -111,6 +201,9 @@ class FaultInjectingDataset:
         for batch in self._inner.device_batches(
             requests, batch_size, start_batch=start_batch
         ):
+            self._fire_hook(index)
+            self._maybe_slow(index)
+            self._maybe_hang(index)
             self._check_faults(index)
             yield self._maybe_corrupt(index, batch)
             index += 1
@@ -124,6 +217,9 @@ class FaultInjectingDataset:
         for chunk in self._inner.device_scan_chunks(
             requests, batch_size, start_chunk=start_chunk, **kwargs
         ):
+            self._fire_hook(index)
+            self._maybe_slow(index)
+            self._maybe_hang(index)
             self._check_faults(index)
             yield chunk
             index += 1

@@ -47,6 +47,12 @@ class VerificationResult:
         # engine.resilience.ScanDegradation when the run's scans
         # quarantined batches; None = clean run (set by the suite)
         self.degradation = None
+        # engine.deadline.ScanInterruption when the run was cancelled
+        # or hit its deadline mid-scan — metrics are partial, the
+        # overall status floors per config.degradation_policy, and
+        # interruption.checkpointed says whether a resume cursor was
+        # persisted; None = ran to completion (set by the suite)
+        self.interruption = None
 
     def row_level_results_as_dataset(
         self,
@@ -124,7 +130,15 @@ class VerificationSuite:
         reuse_existing_results_for_key=None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key=None,
+        deadline=None,
+        cancel=None,
     ) -> VerificationResult:
+        """Run all checks. ``deadline`` (seconds or a ``RunBudget``) and
+        ``cancel`` (a ``CancelToken``) bound the run — an interrupt
+        still returns a result: partial metrics, the overall status
+        floored per ``config.degradation_policy``, and
+        ``result.interruption`` carrying the provenance
+        (docs/RESILIENCE.md, "Deadlines & cancellation")."""
         analyzers = list(required_analyzers) + [
             a for check in checks for a in check.required_analyzers()
         ]
@@ -138,8 +152,23 @@ class VerificationSuite:
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
             save_or_append_results_with_key=save_or_append_results_with_key,
+            deadline=deadline,
+            cancel=cancel,
         )
         return VerificationSuite.evaluate(checks, context, data=data)
+
+    @staticmethod
+    def install_graceful_shutdown(signals=None):
+        """Opt-in SIGTERM handling: maps process shutdown onto the
+        process-wide shutdown ``CancelToken``, so every supervised run
+        exits cleanly (final checkpoint, partial metrics) when the
+        orchestrator says stop. Returns an ``uninstall()`` callable.
+        See ``deequ_tpu.engine.deadline.install_graceful_shutdown``."""
+        from deequ_tpu.engine.deadline import install_graceful_shutdown
+
+        if signals is None:
+            return install_graceful_shutdown()
+        return install_graceful_shutdown(signals)
 
     @staticmethod
     def run_on_aggregated_states(
@@ -186,7 +215,14 @@ class VerificationSuite:
         # "warn" (surface but don't fail), or "tolerate" (status driven
         # by the checks alone; the record still rides the result)
         degradation = getattr(context, "degradation", None)
-        if degradation is not None and degradation.is_degraded:
+        # an interrupted run (cancelled / deadline-exceeded) also
+        # computed its metrics over PARTIAL data — same policy floor as
+        # quarantine: partial data is an Error under "fail", a Warning
+        # under "warn", and check-driven under "tolerate"
+        interruption = getattr(context, "interruption", None)
+        if (
+            degradation is not None and degradation.is_degraded
+        ) or interruption is not None:
             from deequ_tpu import config
 
             policy = config.options().degradation_policy
@@ -204,13 +240,15 @@ class VerificationSuite:
             status = max(
                 (status, floor), key=lambda s: order.index(s.value)
             )
-            tm.counter("checks.degraded_runs").inc()
+            if degradation is not None and degradation.is_degraded:
+                tm.counter("checks.degraded_runs").inc()
         result = VerificationResult(
             status, check_results, context.metric_map, data=data
         )
         result.run_metadata = context.run_metadata
         result.telemetry = context.telemetry
         result.degradation = degradation
+        result.interruption = interruption
         return result
 
 
@@ -229,6 +267,8 @@ class VerificationRunBuilder:
         self._fail_if_results_missing = False
         self._save_key = None
         self._anomaly_checks: List = []
+        self._deadline = None
+        self._cancel = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -250,6 +290,17 @@ class VerificationRunBuilder:
 
     def with_engine(self, engine: AnalysisEngine) -> "VerificationRunBuilder":
         self._engine = engine
+        return self
+
+    def with_deadline(self, deadline) -> "VerificationRunBuilder":
+        """Bound the run: seconds (float) or a full ``RunBudget``."""
+        self._deadline = deadline
+        return self
+
+    def with_cancel(self, cancel) -> "VerificationRunBuilder":
+        """Attach a ``CancelToken`` — cancelling it mid-run exits the
+        scan cleanly with partial metrics + a resumable checkpoint."""
+        self._cancel = cancel
         return self
 
     def aggregate_with(self, state_loader) -> "VerificationRunBuilder":
@@ -320,4 +371,6 @@ class VerificationRunBuilder:
             reuse_existing_results_for_key=self._reuse_key,
             fail_if_results_missing=self._fail_if_results_missing,
             save_or_append_results_with_key=self._save_key,
+            deadline=self._deadline,
+            cancel=self._cancel,
         )

@@ -1,0 +1,807 @@
+"""Deadlines, cooperative cancellation, and watchdog supervision
+(docs/RESILIENCE.md, "Deadlines & cancellation").
+
+Every timing-sensitive test runs on an injected ManualClock: fake time
+is advanced only by the fault that is actually hanging (hang ticks, a
+slow batch's one-shot delay), never by a free-running timer — so no
+test here sleeps wall-clock time, and an autouse guard fails any test
+that tries. The load-bearing differentials: a stalled batch flows
+through PR 3's retry -> quarantine path and the run COMPLETES degraded;
+a cancelled run checkpoints its final cursor and the resumed run is
+bit-identical to an uninterrupted one, on resident, streaming and mesh
+paths alike.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxQuantile,
+    Completeness,
+    Mean,
+    Size,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.engine.deadline import (
+    AdmissionController,
+    CancelToken,
+    DeadlineExceeded,
+    ManualClock,
+    RunBudget,
+    RunCancelled,
+    ScanSupervisor,
+    install_graceful_shutdown,
+    reset_shutdown_token,
+    shutdown_installed,
+    shutdown_token,
+)
+from deequ_tpu.engine.resilience import RetryPolicy, ScanStalled
+from deequ_tpu.engine.scan import AnalysisEngine, active_prefetch_workers
+from deequ_tpu.io.state_provider import ScanCheckpointer
+from deequ_tpu.io.storage import LocalStorage, interprocess_lock
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.testing.faults import FaultInjectingDataset
+from deequ_tpu.verification.suite import VerificationSuite
+
+
+@pytest.fixture(autouse=True)
+def _no_wall_sleeps(monkeypatch):
+    """The module contract: supervision tests never wall-sleep. Any
+    sleep over a second means a fake-clock path regressed into real
+    waiting — fail the test rather than hang CI."""
+    real_sleep = time.sleep
+
+    def guarded(seconds):
+        assert seconds <= 1.0, (
+            f"test slept {seconds}s of real time — deadline tests must "
+            "run on the injected ManualClock"
+        )
+        real_sleep(seconds)
+
+    monkeypatch.setattr(time, "sleep", guarded)
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+FAST_RETRY = RetryPolicy(max_attempts=3, sleep=_no_sleep)
+
+
+def _table_data(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.normal(size=n).tolist(),
+        "g": (np.arange(n) % 7).tolist(),
+    }
+
+
+ANALYZERS = [
+    Size(),
+    Completeness("a"),
+    Mean("a"),
+    ApproxQuantile("a", 0.5),
+    Uniqueness(["g"]),
+]
+
+
+def _metric_values(ctx, analyzers=ANALYZERS):
+    out = []
+    for a in analyzers:
+        value = ctx.metric(a).value
+        assert value.is_success, (a, value)
+        out.append((str(a), value.get()))
+    return out
+
+
+def _mode_setup(mode, cpu_mesh):
+    if mode == "resident":
+        return (lambda **kw: AnalysisEngine(**kw)), dict(
+            device_cache_bytes=1 << 30, batch_size=104
+        )
+    if mode == "streaming":
+        return (lambda **kw: AnalysisEngine(**kw)), dict(
+            device_cache_bytes=0, batch_size=104
+        )
+    assert mode == "mesh"
+    return (lambda **kw: AnalysisEngine(mesh=cpu_mesh, **kw)), dict(
+        device_cache_bytes=0, batch_size=104
+    )
+
+
+MODES = ["resident", "streaming", "mesh"]
+
+
+def _stall_budget(stall_s=1.0, deadline_s=10_000.0):
+    """A generous fake-clock envelope: only injected faults advance the
+    clock, so the deadline never fires unless a test advances past it."""
+    return RunBudget(
+        deadline_s=deadline_s, stall_s=stall_s, clock=ManualClock()
+    )
+
+
+# --------------------------------------------------------------------------
+# CancelToken
+# --------------------------------------------------------------------------
+
+
+class TestCancelToken:
+    def test_cancel_sets_reason_and_raises(self):
+        token = CancelToken()
+        assert not token.cancelled and token.reason is None
+        token.raise_if_cancelled()  # no-op while active
+        token.cancel("operator said stop")
+        assert token.cancelled
+        assert token.reason == "operator said stop"
+        with pytest.raises(RunCancelled, match="operator said stop"):
+            token.raise_if_cancelled()
+        # idempotent: the first reason wins
+        token.cancel("second")
+        assert token.reason == "operator said stop"
+
+    def test_parent_cancels_children_transitively(self):
+        parent = CancelToken()
+        child = parent.child()
+        grandchild = child.child()
+        parent.cancel("drain")
+        assert child.cancelled and grandchild.cancelled
+        assert grandchild.reason == "drain"
+
+    def test_child_cancel_leaves_parent_active(self):
+        parent = CancelToken()
+        child = parent.child()
+        child.cancel("just me")
+        assert child.cancelled
+        assert not parent.cancelled
+
+    def test_linking_to_cancelled_parent_cancels_immediately(self):
+        parent = CancelToken()
+        parent.cancel("already gone")
+        child = parent.child()
+        assert child.cancelled and child.reason == "already gone"
+
+    def test_wait(self):
+        token = CancelToken()
+        assert token.wait(timeout=0) is False
+        token.cancel()
+        assert token.wait(timeout=0) is True
+
+
+# --------------------------------------------------------------------------
+# RunBudget on a ManualClock
+# --------------------------------------------------------------------------
+
+
+class TestRunBudget:
+    def test_deadline_on_manual_clock(self):
+        clock = ManualClock()
+        budget = RunBudget(deadline_s=10.0, clock=clock)
+        budget.start()
+        assert budget.remaining() == 10.0
+        clock.advance(4.0)
+        assert budget.elapsed() == 4.0
+        assert budget.remaining() == 6.0
+        assert not budget.expired()
+        budget.check()
+        clock.advance(7.0)
+        assert budget.expired()
+        with pytest.raises(DeadlineExceeded, match="10.0s"):
+            budget.check()
+
+    def test_start_is_idempotent(self):
+        clock = ManualClock()
+        budget = RunBudget(deadline_s=10.0, clock=clock)
+        budget.start()
+        clock.advance(5.0)
+        budget.start()  # the profiler's later passes must NOT reset it
+        assert budget.elapsed() == 5.0
+
+    def test_no_deadline_never_expires(self):
+        budget = RunBudget(stall_s=1.0, clock=ManualClock())
+        budget.start()
+        budget.clock.advance(1e9)
+        assert budget.remaining() is None
+        assert not budget.expired()
+        budget.check()
+
+    def test_unstarted_budget_has_zero_elapsed(self):
+        assert RunBudget(deadline_s=1.0, clock=ManualClock()).elapsed() == 0.0
+
+
+# --------------------------------------------------------------------------
+# ScanSupervisor: one stall rule, three observation points
+# --------------------------------------------------------------------------
+
+
+class TestScanSupervisor:
+    def test_on_wait_raises_after_stall_window(self):
+        sup = ScanSupervisor(_stall_budget(stall_s=2.0))
+        tm = get_telemetry()
+        before = tm.counter("engine.stalls_detected").value
+        sup.clock.advance(1.0)
+        sup.on_wait()  # within the window: nothing
+        sup.clock.advance(1.5)
+        with pytest.raises(ScanStalled, match="stalled source"):
+            sup.on_wait()
+        assert tm.counter("engine.stalls_detected").value == before + 1
+        # the raise re-armed the window — the retry must get fresh time
+        sup.on_wait()
+
+    def test_note_arrival_catches_slow_batch(self):
+        sup = ScanSupervisor(_stall_budget(stall_s=2.0))
+        sup.clock.advance(1.0)
+        sup.note_arrival()  # timely: re-arms
+        sup.clock.advance(3.0)
+        with pytest.raises(ScanStalled, match="stall limit"):
+            sup.note_arrival()
+
+    def test_watchdog_check_releases_armed_source(self):
+        sup = ScanSupervisor(_stall_budget(stall_s=2.0))
+        event = sup.arm_source()
+        sup.watchdog_check()
+        assert not event.is_set()
+        sup.clock.advance(3.0)
+        sup.watchdog_check()
+        assert event.is_set()
+        assert sup.stalls == 1
+        # a fresh arm (iterator restart) is a fresh, un-set event
+        assert not sup.arm_source().is_set()
+
+    def test_cancel_reported_before_deadline(self):
+        token = CancelToken()
+        budget = RunBudget(deadline_s=1.0, clock=ManualClock())
+        sup = ScanSupervisor(budget, [token])
+        sup.clock.advance(5.0)
+        token.cancel("explicit")
+        # both fired; the explicit cancel is the more specific reason
+        with pytest.raises(RunCancelled, match="explicit"):
+            sup.check()
+
+    def test_watchdog_releases_source_on_cancel(self):
+        token = CancelToken()
+        sup = ScanSupervisor(None, [token])
+        event = sup.arm_source()
+        token.cancel()
+        sup.watchdog_check()
+        assert event.is_set()
+
+
+# --------------------------------------------------------------------------
+# Admission control
+# --------------------------------------------------------------------------
+
+
+def _spin_until(predicate, what, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.001)
+
+
+class TestAdmissionController:
+    def test_fifo_ordering(self):
+        ctl = AdmissionController()
+        ctl.acquire(1)  # occupy the only slot
+        order = []
+
+        def worker(n):
+            ctl.acquire(1)
+            order.append(n)
+            ctl.release()
+
+        t1 = threading.Thread(target=worker, args=(1,))
+        t1.start()
+        _spin_until(lambda: ctl.snapshot()["queued"] == 1, "t1 queued")
+        t2 = threading.Thread(target=worker, args=(2,))
+        t2.start()
+        _spin_until(lambda: ctl.snapshot()["queued"] == 2, "t2 queued")
+        ctl.release()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert order == [1, 2]
+        assert ctl.snapshot() == {"active": 0, "queued": 0}
+
+    def test_queued_run_expires_under_its_deadline(self):
+        ctl = AdmissionController()
+        ctl.acquire(1)
+        budget = RunBudget(deadline_s=5.0, clock=ManualClock())
+        budget.start()
+        budget.clock.advance(10.0)
+        with pytest.raises(DeadlineExceeded, match="queued for admission"):
+            ctl.acquire(1, budget=budget)
+        # the dead ticket was removed — the queue is clean
+        assert ctl.snapshot()["queued"] == 0
+        ctl.release()
+
+    def test_queued_run_cancellable(self):
+        ctl = AdmissionController()
+        ctl.acquire(1)
+        token = CancelToken()
+        token.cancel("gave up waiting")
+        with pytest.raises(RunCancelled, match="gave up waiting"):
+            ctl.acquire(1, tokens=[token])
+        assert ctl.snapshot()["queued"] == 0
+        ctl.release()
+
+    def test_acquire_starts_budget_epoch(self):
+        ctl = AdmissionController()
+        budget = RunBudget(deadline_s=5.0, clock=ManualClock())
+        ctl.acquire(4, budget=budget)  # free slot: admitted immediately
+        assert budget._started_at is not None
+        ctl.release()
+
+    def test_config_knob_end_to_end(self):
+        from deequ_tpu.engine.deadline import admission_controller
+
+        tm = get_telemetry()
+        queued_before = tm.counter("engine.runs_queued").value
+        with config.configure(max_concurrent_runs=1):
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict({"x": [1.0, 2.0, 3.0]}), [Size()]
+            )
+        assert ctx.metric(Size()).value.get() == 3
+        # uncontended: admitted without queueing, slot released after
+        assert tm.counter("engine.runs_queued").value == queued_before
+        assert admission_controller().snapshot()["active"] == 0
+
+
+# --------------------------------------------------------------------------
+# Cross-process repository lock + durable writes (io satellites)
+# --------------------------------------------------------------------------
+
+
+class TestInterprocessLock:
+    def test_serializes_across_file_descriptors(self, tmp_path):
+        """flock conflicts between separate opens of the lock file even
+        in one process — exactly how two worker PROCESSES would contend."""
+        lock_path = str(tmp_path / "repo.lock")
+        entered = threading.Event()
+        released = threading.Event()
+
+        def contender():
+            with interprocess_lock(lock_path):
+                entered.set()
+
+        with interprocess_lock(lock_path):
+            t = threading.Thread(target=contender)
+            t.start()
+            # the second acquire must block while we hold the lock
+            assert not entered.wait(timeout=0.1)
+            released.set()
+        t.join(timeout=5)
+        assert entered.is_set()
+
+    def test_repository_save_is_lost_update_free(self, tmp_path):
+        """Two repository INSTANCES on one file (distinct in-process
+        locks, like two workers) appending concurrently: every save must
+        survive the read-modify-write."""
+        from deequ_tpu.repository.base import AnalysisResult, ResultKey
+        from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+        path = str(tmp_path / "metrics.json")
+        ctx = AnalysisRunner.do_analysis_run(
+            Dataset.from_pydict({"x": [1.0, 2.0]}), [Size()]
+        )
+        repos = [
+            FileSystemMetricsRepository(path),
+            FileSystemMetricsRepository(path),
+        ]
+
+        def writer(repo, worker):
+            for i in range(10):
+                key = ResultKey.of(
+                    1000 + i, {"worker": str(worker), "i": str(i)}
+                )
+                repo.save(AnalysisResult(key, ctx))
+
+        threads = [
+            threading.Thread(target=writer, args=(repo, w))
+            for w, repo in enumerate(repos)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(repos[0].load().get()) == 20
+
+
+class TestDurableWrites:
+    def test_durable_local_write_round_trips(self, tmp_path):
+        storage = LocalStorage(str(tmp_path))
+        storage.write_bytes("ckpt.bin", b"payload", durable=True)
+        assert storage.read_bytes("ckpt.bin") == b"payload"
+        # no temp-file orphans after the fsync + replace
+        assert storage.list_keys() == ["ckpt.bin"]
+
+    def test_checkpointer_falls_back_on_legacy_storage(self, tmp_path):
+        """A Storage subclass predating ``durable=`` still checkpoints."""
+        from deequ_tpu.io.state_provider import ScanCursor
+
+        class LegacyStorage:
+            def __init__(self):
+                self.blobs = {}
+
+            def read_bytes(self, key):
+                return self.blobs.get(key)
+
+            def write_bytes(self, key, data):  # no durable kwarg
+                self.blobs[key] = bytes(data)
+
+        ckpt = ScanCheckpointer(str(tmp_path))
+        ckpt._storage = LegacyStorage()
+        cursor = ScanCursor(
+            batch_index=3, row_offset=312,
+            source_fingerprint="fp", batch_size=104,
+        )
+        ckpt.save(cursor, "tok", (), {}, None)
+        assert ckpt.load("fp", "tok")["cursor"].batch_index == 3
+
+
+# --------------------------------------------------------------------------
+# Engine-level: stall -> retry -> quarantine, cancel -> checkpoint ->
+# resume, deadline -> partial metrics — all modes, all fake-clock
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+class TestEngineSupervision:
+    def test_stall_retried_then_bit_identical(self, mode, cpu_mesh):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        data = _table_data()
+        with config.configure(scan_retry=FAST_RETRY, **opts):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(data), ANALYZERS,
+                    engine=make_engine(),
+                )
+            )
+            budget = _stall_budget(stall_s=1.0)
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(data),
+                hang_at_batch={3: 1},
+                clock=budget.clock,
+            )
+            tm = get_telemetry()
+            stalls_before = tm.counter("engine.stalls_detected").value
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine(budget=budget)
+            )
+        assert _metric_values(ctx) == ref
+        assert ("hang", 3) in ds.faults_fired
+        assert tm.counter("engine.stalls_detected").value > stalls_before
+        degr = ctx.degradation
+        assert degr is not None and degr.retries >= 1
+        assert not degr.is_degraded
+        assert ctx.interruption is None  # stalls degrade, never interrupt
+
+    def test_persistent_stall_quarantined_and_run_completes(
+        self, mode, cpu_mesh
+    ):
+        """THE acceptance path: a batch that hangs every attempt is
+        detected by the watchdog, retried, quarantined — and the run
+        COMPLETES degraded, entirely on the fake clock."""
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        budget = _stall_budget(stall_s=1.0)
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()),
+            hang_at_batch={3: 99},  # re-hangs on every retry
+            clock=budget.clock,
+        )
+        with config.configure(scan_retry=FAST_RETRY, **opts):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=make_engine(budget=budget)
+            )
+        degr = ctx.degradation
+        assert degr is not None and degr.is_degraded
+        assert degr.batches_quarantined == 1
+        assert degr.rows_skipped == 104
+        assert degr.failures[0].error_class == "ScanStalled"
+        # the run finished: every metric computed over the partial data
+        assert ctx.metric(Size()).value.get() == 1000 - 104
+        # well inside the (fake) deadline, and no interrupt was recorded
+        assert not budget.expired()
+        assert ctx.interruption is None
+        # teardown joined every prefetch worker — no thread leak
+        assert active_prefetch_workers() == []
+
+    def test_cancel_mid_scan_checkpoints_then_resume_bit_identical(
+        self, mode, cpu_mesh, tmp_path
+    ):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        data = _table_data()
+        tm = get_telemetry()
+        with config.configure(
+            scan_retry=FAST_RETRY, checkpoint_every_batches=100, **opts
+        ):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_pydict(data), ANALYZERS,
+                    engine=make_engine(),
+                )
+            )
+            token = CancelToken()
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(data),
+                on_batch={5: lambda: token.cancel("user clicked stop")},
+            )
+            ckpt = ScanCheckpointer(str(tmp_path))
+            cancelled_before = tm.counter("engine.runs_cancelled").value
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS,
+                engine=make_engine(checkpointer=ckpt), cancel=token,
+            )
+            # the interrupted run RETURNED (never raised), with
+            # provenance and a persisted resume cursor
+            interruption = ctx.interruption
+            assert interruption is not None
+            assert interruption.kind == "cancelled"
+            assert "user clicked stop" in interruption.reason
+            assert interruption.checkpointed
+            assert 0 < interruption.batch_index < 10
+            assert tm.counter("engine.runs_cancelled").value > cancelled_before
+            assert ckpt._storage.list_keys("scan-ckpt-")
+            # partial metrics cover exactly the checkpointed batches
+            size = ctx.metric(Size()).value.get()
+            assert size == interruption.batch_index * 104
+
+            resumes_before = tm.counter("engine.resumes").value
+            ctx2 = AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict(data), ANALYZERS,
+                engine=make_engine(checkpointer=ckpt),
+            )
+            assert tm.counter("engine.resumes").value - resumes_before == 1
+        assert _metric_values(ctx2) == ref
+        assert ctx2.interruption is None
+        # completion cleared the cursor
+        assert ckpt._storage.list_keys("scan-ckpt-") == []
+        assert active_prefetch_workers() == []
+
+    def test_pre_cancelled_run_returns_cleanly(self, mode, cpu_mesh):
+        make_engine, opts = _mode_setup(mode, cpu_mesh)
+        token = CancelToken()
+        token.cancel("cancelled before start")
+        with config.configure(**opts):
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict(_table_data()), ANALYZERS,
+                engine=make_engine(), cancel=token,
+            )
+        assert ctx.interruption is not None
+        assert ctx.interruption.batch_index == 0
+        assert not ctx.interruption.checkpointed
+        # pristine init states: zero rows scanned
+        assert ctx.metric(Size()).value.get() == 0
+
+
+class TestDeadlineMidScan:
+    def test_slow_batch_burns_deadline_partial_metrics(self):
+        # resident mode: the source is consumed on the scan thread, so
+        # the fake-clock advance lands between two exact batches (the
+        # streaming prefetch thread would race ahead of the consumer)
+        budget = RunBudget(deadline_s=10.0, clock=ManualClock())
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()),
+            slow_batch={2: 50.0},  # one batch eats 5x the deadline
+            clock=budget.clock,
+        )
+        tm = get_telemetry()
+        before = tm.counter("engine.deadline_exceeded").value
+        with config.configure(device_cache_bytes=1 << 30, batch_size=104):
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, ANALYZERS, engine=AnalysisEngine(budget=budget)
+            )
+        interruption = ctx.interruption
+        assert interruption is not None and interruption.kind == "deadline"
+        assert tm.counter("engine.deadline_exceeded").value == before + 1
+        # exactly batches 0 and 1 finished before the slow batch burned
+        # the envelope; metrics are partial but correct over them
+        assert interruption.batch_index == 2
+        assert ctx.metric(Size()).value.get() == 2 * 104
+
+    def test_config_deadline_knob(self):
+        # a sub-nanosecond budget from config: the run exits through
+        # the interruption path without any explicit RunBudget
+        with config.configure(
+            run_deadline_seconds=1e-9, device_cache_bytes=0, batch_size=104
+        ):
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_pydict(_table_data()), ANALYZERS
+            )
+        assert ctx.interruption is not None
+        assert ctx.interruption.kind == "deadline"
+
+
+# --------------------------------------------------------------------------
+# Verification flooring + builder surface
+# --------------------------------------------------------------------------
+
+
+class TestInterruptionFloorsVerification:
+    def _interrupted_result(self, policy):
+        token = CancelToken()
+        # checks that PASS on the partial data — status movement below
+        # comes from the interruption floor alone
+        check = Check(CheckLevel.ERROR, "robust").has_size(lambda s: s > 0)
+        ds = FaultInjectingDataset(
+            Dataset.from_pydict(_table_data()),
+            on_batch={5: lambda: token.cancel("drain")},
+        )
+        with config.configure(
+            device_cache_bytes=0, batch_size=104,
+            degradation_policy=policy,
+        ):
+            return VerificationSuite.do_verification_run(
+                ds, [check], cancel=token
+            )
+
+    def test_fail_policy_floors_to_error(self):
+        result = self._interrupted_result("fail")
+        assert result.status == CheckStatus.ERROR
+        assert result.interruption is not None
+        assert result.interruption.kind == "cancelled"
+
+    def test_warn_policy_floors_to_warning(self):
+        result = self._interrupted_result("warn")
+        assert result.status == CheckStatus.WARNING
+
+    def test_tolerate_policy_keeps_check_status(self):
+        result = self._interrupted_result("tolerate")
+        assert result.status == CheckStatus.SUCCESS
+        # the provenance still rides the result for consumers
+        assert result.interruption is not None
+
+    def test_builder_deadline_and_cancel_wire_through(self):
+        token = CancelToken()
+        token.cancel("pre-cancelled")
+        result = (
+            VerificationSuite()
+            .on_data(Dataset.from_pydict(_table_data()))
+            .add_check(
+                Check(CheckLevel.ERROR, "x").has_size(lambda s: s == 1000)
+            )
+            .with_cancel(token)
+            .run()
+        )
+        assert result.interruption is not None
+
+
+# --------------------------------------------------------------------------
+# Graceful shutdown (SIGTERM)
+# --------------------------------------------------------------------------
+
+
+class TestGracefulShutdown:
+    def test_sigterm_maps_to_shutdown_token(self):
+        import signal
+
+        uninstall = install_graceful_shutdown()
+        try:
+            assert shutdown_installed()
+            assert not shutdown_token().cancelled
+            signal.raise_signal(signal.SIGTERM)
+            assert shutdown_token().cancelled
+            assert "SIGTERM" in shutdown_token().reason
+        finally:
+            uninstall()
+            reset_shutdown_token()
+        assert not shutdown_installed()
+        assert not shutdown_token().cancelled
+
+    def test_sigterm_mid_scan_exits_with_provenance(self):
+        import signal
+
+        uninstall = install_graceful_shutdown()
+        try:
+            # resident mode: the hook runs on the main thread, so the
+            # Python-level handler fires at the next bytecode boundary
+            ds = FaultInjectingDataset(
+                Dataset.from_pydict(_table_data()),
+                on_batch={3: lambda: signal.raise_signal(signal.SIGTERM)},
+            )
+            with config.configure(
+                device_cache_bytes=1 << 30, batch_size=104
+            ):
+                ctx = AnalysisRunner.do_analysis_run(ds, ANALYZERS)
+            assert ctx.interruption is not None
+            assert ctx.interruption.kind == "cancelled"
+            assert "SIGTERM" in ctx.interruption.reason
+        finally:
+            uninstall()
+            reset_shutdown_token()
+
+
+# --------------------------------------------------------------------------
+# Profiler: one envelope across passes
+# --------------------------------------------------------------------------
+
+
+class TestProfilerEnvelope:
+    def test_interrupted_pass_skips_the_rest(self):
+        from deequ_tpu.profiles.profiler import ColumnProfiler
+
+        data = Dataset.from_pydict(_table_data())
+        tm = get_telemetry()
+        runs_before = tm.counter("runner.runs").value
+        token = CancelToken()
+        token.cancel("budget spent elsewhere")
+        with config.configure(device_cache_bytes=0, batch_size=104):
+            profiles = ColumnProfiler.profile(data, cancel=token)
+        assert profiles.interruption is not None
+        # pass 1 discovered the dead envelope; passes 2/3 never ran
+        assert tm.counter("runner.runs").value - runs_before == 1
+
+    def test_float_deadline_becomes_shared_budget(self):
+        from deequ_tpu.profiles.profiler import ColumnProfiler
+
+        data = Dataset.from_pydict(_table_data())
+        with config.configure(device_cache_bytes=0, batch_size=104):
+            profiles = ColumnProfiler.profile(data, deadline=3600.0)
+        # a generous deadline: profiled to completion, no interruption
+        assert profiles.interruption is None
+        assert profiles.num_records == 1000
+
+
+# --------------------------------------------------------------------------
+# Telemetry + obs_report rendering
+# --------------------------------------------------------------------------
+
+
+class TestSupervisionTelemetry:
+    def test_obs_report_renders_supervision_section(self):
+        from tools.obs_report import render_run
+
+        summary = {
+            "run_id": 7,
+            "name": "supervised",
+            "wall_s": 1.0,
+            "counters": {
+                "engine.stalls_detected": 2,
+                "engine.runs_cancelled": 1,
+                "engine.runs_queued": 3,
+            },
+            "events": [
+                {"event": "scan_stalled", "stall_s": 1.0, "stalls": 2},
+                {
+                    "event": "run_cancelled",
+                    "kind": "deadline",
+                    "reason": "run deadline of 10s exhausted",
+                    "batch_index": 5,
+                    "row_offset": 520,
+                    "checkpointed": True,
+                },
+            ],
+        }
+        text = render_run(summary)
+        assert "engine.stalls_detected" in text
+        assert "engine.runs_cancelled" in text
+        assert "engine.runs_queued" in text
+        assert "stall detected" in text
+        assert "run interrupted (deadline)" in text
+
+    def test_events_emitted_end_to_end(self):
+        tm = get_telemetry()
+        budget = _stall_budget(stall_s=1.0)
+        with config.configure(
+            device_cache_bytes=0, batch_size=104, scan_retry=FAST_RETRY
+        ):
+            with tm.run("supervision-report") as cap:
+                ds = FaultInjectingDataset(
+                    Dataset.from_pydict(_table_data()),
+                    hang_at_batch={2: 99},
+                    clock=budget.clock,
+                )
+                AnalysisRunner.do_analysis_run(
+                    ds, ANALYZERS, engine=AnalysisEngine(budget=budget)
+                )
+        summary = cap.final
+        assert summary["counters"].get("engine.stalls_detected", 0) >= 1
+        assert any(
+            e.get("event") == "scan_stalled"
+            for e in summary.get("events", [])
+        )

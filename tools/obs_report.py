@@ -78,6 +78,10 @@ def render_run(summary: Dict[str, Any]) -> str:
         "engine.batches_quarantined",
         "engine.checkpoints_written",
         "engine.resumes",
+        "engine.stalls_detected",
+        "engine.deadline_exceeded",
+        "engine.runs_cancelled",
+        "engine.runs_queued",
     )
     if any(res_counters.get(k) for k in res_keys):
         lines.append("  resilience:")
@@ -92,6 +96,19 @@ def render_run(summary: Dict[str, Any]) -> str:
                     f" {e.get('error_class')}"
                     f" (rows={e.get('rows')},"
                     f" attempts={e.get('attempts')})"
+                )
+            elif e.get("event") == "scan_stalled":
+                lines.append(
+                    f"    stall detected: no batch for"
+                    f" {e.get('stall_s')}s"
+                    f" (stalls={e.get('stalls')})"
+                )
+            elif e.get("event") == "run_cancelled":
+                lines.append(
+                    f"    run interrupted ({e.get('kind')}):"
+                    f" {e.get('reason')}"
+                    f" [batch={e.get('batch_index')},"
+                    f" checkpointed={e.get('checkpointed')}]"
                 )
 
     spills = [
