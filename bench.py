@@ -679,6 +679,68 @@ def bench_resilience_overhead(num_rows: int = 4_000_000):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_memory_backoff_overhead(num_rows: int = 4_000_000):
+    """Memory-protection tax on a CLEAN scan (docs/RESILIENCE.md
+    "Memory pressure"): the same streaming fused-bundle run with the
+    adaptive batch backoff armed (config.memory_backoff, the default)
+    vs disabled. No allocation failure fires — this prices the
+    machinery alone: the per-dispatch try frame, the backoff controller
+    checks, and the effective-batch gauge. Acceptance bar is <2%
+    overhead (a clean run must not pay for protection it never uses)."""
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+
+    def make(seed):
+        rng = np.random.default_rng(seed)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    f"n{i}": rng.normal(0, 1, num_rows).astype(np.float32)
+                    for i in range(10)
+                }
+            )
+        )
+
+    analyzers = []
+    for i in range(10):
+        analyzers += [
+            Mean(f"n{i}"),
+            StandardDeviation(f"n{i}"),
+            Minimum(f"n{i}"),
+            Maximum(f"n{i}"),
+        ]
+    analyzers.append(Compliance("n0 pos", "n0 > 0"))
+
+    with config.configure(device_cache_bytes=0, batch_size=1 << 19):
+        AnalysisRunner.do_analysis_run(make(41), analyzers)  # warm
+        fresh = make(42)
+        with config.configure(memory_backoff=False):
+            off_wall, _, _, _ = _timed(
+                lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+            )
+        with config.configure(memory_backoff=True):
+            on_wall, _, _, _ = _timed(
+                lambda: AnalysisRunner.do_analysis_run(fresh, analyzers)
+            )
+    return {
+        "unprotected_wall_s": off_wall,
+        "protected_wall_s": on_wall,
+        "overhead_pct": round(
+            100.0 * (on_wall - off_wall) / off_wall, 2
+        ) if off_wall > 0 else 0.0,
+    }
+
+
 def bench_watchdog_overhead(num_rows: int = 4_000_000):
     """Supervision tax on a CLEAN scan (docs/RESILIENCE.md): the same
     streaming fused-bundle run with a run budget armed (watchdog thread
@@ -898,10 +960,10 @@ def main(argv=None):
     parser.add_argument(
         "--budget",
         type=float,
-        default=float(os.environ.get("DEEQU_TPU_BENCH_BUDGET_S", "600")),
+        default=float(os.environ.get("DEEQU_TPU_BENCH_BUDGET_S", "1200")),
         help="overall wall budget in seconds; secondary configs are "
         "skipped once the remainder can't cover their estimated cost "
-        "(default: $DEEQU_TPU_BENCH_BUDGET_S or 600)",
+        "(default: $DEEQU_TPU_BENCH_BUDGET_S or 1200)",
     )
     parser.add_argument(
         "--quick",
@@ -925,6 +987,50 @@ def main(argv=None):
     except Exception as exc:  # headline failure must not kill the line
         detail["error"] = repr(exc)
 
+    def headline_line() -> dict:
+        prof = detail.get("profiler")
+        if isinstance(prof, dict):
+            rows_per_sec = prof["rows_per_sec"]
+            return {
+                "metric": "rows/sec/chip, full ColumnProfiler "
+                f"({prof_rows}x{prof_cols} scaled TPC-DS-like)",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec/chip",
+                "vs_baseline": round(
+                    rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 4
+                ),
+                # decomposition context: the tunneled chip's
+                # host->device link swings 4-1400 MB/s between runs and
+                # fresh-data walls are usually link-bound;
+                # resident_rows_per_sec is the chip's compute/dispatch
+                # capability with data in HBM (what a real pod reading
+                # from local storage at GB/s would see)
+                "link_mb_per_sec": round(prof["link_mb_per_sec"], 2),
+                "resident_rows_per_sec": round(
+                    prof["resident_rows_per_sec"], 1
+                ),
+            }
+        return {  # headline config failed: the line still prints
+            "metric": "rows/sec/chip, full ColumnProfiler "
+            f"({prof_rows}x{prof_cols} scaled TPC-DS-like)",
+            "value": 0.0,
+            "unit": "rows/sec/chip",
+            "vs_baseline": 0.0,
+            "error": detail.get("error", "headline config failed"),
+        }
+
+    # print (and FLUSH) the headline line the moment it exists: if the
+    # harness kills the process mid-secondary (rc=124), stdout still
+    # carries a parseable result — the enriched final line below
+    # supersedes it when the run finishes
+    print(json.dumps({**headline_line(), "preliminary": True}), flush=True)
+    print(
+        f"[bench] headline done at {time.time() - start:.1f}s, "
+        f"{remaining():.0f}s of budget left",
+        file=sys.stderr,
+        flush=True,
+    )
+
     # (name, thunk, estimated cost in seconds) — an estimate is the
     # gate: a config only starts when the remaining budget covers it,
     # so the overall wall stays under --budget instead of rc=124-ing
@@ -941,6 +1047,8 @@ def main(argv=None):
             ("sketches_hll_kll", lambda: bench_sketches(8_000_000), 60),
             ("resilience_overhead",
              lambda: bench_resilience_overhead(4_000_000), 90),
+            ("memory_backoff_overhead",
+             lambda: bench_memory_backoff_overhead(4_000_000), 90),
             ("watchdog_overhead",
              lambda: bench_watchdog_overhead(4_000_000), 90),
             ("profiler_50col",
@@ -967,14 +1075,26 @@ def main(argv=None):
                     "remaining_s": round(remaining(), 1),
                 }
             )
+            print(
+                f"[bench] SKIPPED {name} (est {est_s}s > "
+                f"{remaining():.0f}s remaining)",
+                file=sys.stderr,
+                flush=True,
+            )
             continue
+        print(f"[bench] running {name}...", file=sys.stderr, flush=True)
         t0 = time.time()
         try:
             detail[name] = thunk()
         except Exception as exc:  # secondary configs must not kill the line
             detail.setdefault("errors", {})[name] = repr(exc)
-        detail.setdefault("config_walls", {})[name] = round(
-            time.time() - t0, 1
+        wall = round(time.time() - t0, 1)
+        detail.setdefault("config_walls", {})[name] = wall
+        print(
+            f"[bench] {name}: {wall}s "
+            f"({remaining():.0f}s of budget left)",
+            file=sys.stderr,
+            flush=True,
         )
 
     # the process-wide telemetry picture of everything the bench ran:
@@ -984,36 +1104,7 @@ def main(argv=None):
     detail["telemetry"] = get_telemetry().metrics.snapshot()
     detail["total_wall_s"] = round(time.time() - start, 1)
 
-    prof = detail.get("profiler")
-    if isinstance(prof, dict):
-        rows_per_sec = prof["rows_per_sec"]
-        result = {
-            "metric": "rows/sec/chip, full ColumnProfiler "
-            f"({prof_rows}x{prof_cols} scaled TPC-DS-like)",
-            "value": round(rows_per_sec, 1),
-            "unit": "rows/sec/chip",
-            "vs_baseline": round(
-                rows_per_sec / NORTH_STAR_ROWS_PER_SEC_PER_CHIP, 4
-            ),
-            # decomposition context: the tunneled chip's host->device
-            # link swings 4-1400 MB/s between runs and fresh-data walls
-            # are usually link-bound; resident_rows_per_sec is the
-            # chip's compute/dispatch capability with data in HBM (what
-            # a real pod reading from local storage at GB/s would see)
-            "link_mb_per_sec": round(prof["link_mb_per_sec"], 2),
-            "resident_rows_per_sec": round(
-                prof["resident_rows_per_sec"], 1
-            ),
-        }
-    else:  # headline config failed: the line still prints
-        result = {
-            "metric": "rows/sec/chip, full ColumnProfiler "
-            f"({prof_rows}x{prof_cols} scaled TPC-DS-like)",
-            "value": 0.0,
-            "unit": "rows/sec/chip",
-            "vs_baseline": 0.0,
-            "error": detail.get("error", "headline config failed"),
-        }
+    result = headline_line()
     # the 50-col cell-rate headline (VERDICT r4): resident rate on the
     # north-star-shaped config plus its link-independent projection —
     # the one number to compare round over round regardless of what

@@ -41,6 +41,11 @@ from deequ_tpu.analyzers.base import (
     has_column,
 )
 from deequ_tpu.data.table import ROW_MASK, ColumnRequest, Dataset
+from deequ_tpu.engine.memory import (
+    classify_memory_pressure,
+    oom_probe_of,
+    record_spill_downgrade,
+)
 from deequ_tpu.engine.scan import AnalysisEngine
 from deequ_tpu.metrics.distribution import HistogramMetric
 from deequ_tpu.metrics.metric import DoubleMetric, Entity, Metric
@@ -289,7 +294,10 @@ def plan_frequency_passes(
 
     def make_spill(plan):
         def run():
+            probe = oom_probe_of(dataset)
             try:
+                if probe is not None:
+                    probe("deferred")
                 result = spill_mod.device_spill_frequencies(
                     dataset, plan, engine
                 )
@@ -299,6 +307,16 @@ def plan_frequency_passes(
                 # a sharded hash bucket exceeded its static capacity —
                 # exactness wins: take the host path instead
                 note(plan, "host-arrow-overflow")
+                return _arrow_frequencies(dataset, plan)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if classify_memory_pressure(exc) is None:
+                    raise
+                # device sort buffers did not fit: the last rung of the
+                # downgrade chain is Arrow's host group_by
+                record_spill_downgrade(
+                    "deferred", plan.columns, "host-arrow"
+                )
+                note(plan, "host-arrow-oom")
                 return _arrow_frequencies(dataset, plan)
 
         return run
@@ -408,7 +426,10 @@ def plan_frequency_passes(
 
             def make_joint(plan, dictionaries, sizes):
                 def run():
+                    probe = oom_probe_of(dataset)
                     try:
+                        if probe is not None:
+                            probe("deferred")
                         result = spill_mod.device_spill_joint_frequencies(
                             dataset, plan, engine, dictionaries, sizes
                         )
@@ -416,6 +437,14 @@ def plan_frequency_passes(
                         # a sharded hash bucket exceeded its static
                         # capacity: exactness wins, host path instead
                         note(plan, "host-arrow-overflow")
+                        return _arrow_frequencies(dataset, plan)
+                    except Exception as exc:  # noqa: BLE001
+                        if classify_memory_pressure(exc) is None:
+                            raise
+                        record_spill_downgrade(
+                            "deferred", plan.columns, "host-arrow"
+                        )
+                        note(plan, "host-arrow-oom")
                         return _arrow_frequencies(dataset, plan)
                     note(plan, "device-sort-joint")  # after success
                     return result
@@ -463,7 +492,7 @@ def finalize_dense_states(
 
 
 def finalize_collector_states(
-    collectors, states, isolate: bool = False, cancel=None
+    collectors, states, isolate: bool = False, cancel=None, oom_probe=None
 ) -> Dict[FrequencyPlan, FrequenciesAndNumRows]:
     """Finish every one-pass spill plan from its shared-scan collector
     state. Dispatch order matters for latency: EVERY plan's sort +
@@ -477,7 +506,11 @@ def finalize_collector_states(
     ``cancel`` token (engine/deadline.py) stops launching new per-plan
     sorts and skips the fetch — under ``isolate`` each unfinished plan
     reports the cancellation as its own failure, otherwise
-    ``RunCancelled`` propagates."""
+    ``RunCancelled`` propagates. A finalize whose sort buffers OOM
+    (``MemoryPressureError`` via engine/memory.py — ``oom_probe`` is
+    the fault-injection hook) downgrades to the plan's deferred re-scan
+    path, which itself downgrades to host Arrow under pressure — the
+    collector -> deferred -> Arrow chain, each rung recorded."""
     from deequ_tpu.analyzers.spill import SpillOverflow
     from deequ_tpu.engine.deadline import RunCancelled
     from deequ_tpu.engine.pack import packed_device_get
@@ -499,9 +532,17 @@ def finalize_collector_states(
             )
             continue
         try:
+            if oom_probe is not None:
+                oom_probe("finalize")
             pending, build = spec.dispatch(state)
         except Exception as exc:  # noqa: BLE001 — finalize trace died;
             # the data was consumed, so re-scan via the deferred twin
+            # (a classified OOM records the downgrade first: the
+            # collector -> deferred rung of the chain)
+            if classify_memory_pressure(exc) is not None:
+                record_spill_downgrade(
+                    "finalize", spec.plan.columns, "deferred"
+                )
             try:
                 out[spec.plan] = spec.scan_fallback()
             except Exception as fallback_exc:  # noqa: BLE001
@@ -535,6 +576,17 @@ def finalize_collector_states(
                     raise
                 out[spec.plan] = exc
         except Exception as exc:  # noqa: BLE001
+            if classify_memory_pressure(exc) is not None:
+                # host-side result construction hit pressure: re-scan
+                # via the deferred twin (which can itself downgrade)
+                record_spill_downgrade(
+                    "finalize", spec.plan.columns, "deferred"
+                )
+                try:
+                    out[spec.plan] = spec.scan_fallback()
+                    continue
+                except Exception as fallback_exc:  # noqa: BLE001
+                    exc = fallback_exc
             if not isolate:
                 raise
             out[spec.plan] = exc
@@ -585,6 +637,7 @@ def compute_many_frequencies(
                 collectors,
                 states[len(dense):],
                 cancel=getattr(engine, "cancel", None),
+                oom_probe=oom_probe_of(dataset),
             )
         )
     return results

@@ -24,6 +24,7 @@ from deequ_tpu.analyzers.base import (
     wrap_if_necessary,
 )
 from deequ_tpu.data.table import Dataset, Schema
+from deequ_tpu.engine.memory import oom_probe_of
 from deequ_tpu.engine.scan import AnalysisEngine
 from deequ_tpu.metrics.metric import Metric
 from deequ_tpu.telemetry import get_telemetry, merge_summaries
@@ -208,13 +209,29 @@ class AnalysisRunner:
 
         admitted = False
         limit = opts.max_concurrent_runs
+        # high-watermark gate (docs/RESILIENCE.md "Memory pressure"):
+        # with a watermark configured, runs also queue once the SUM of
+        # their estimated device footprints would exceed it — queueing
+        # instead of co-OOMing. Zero-cost default: no watermark -> no
+        # estimate, and with no run limit either, no admission at all
+        watermark = opts.memory_watermark_bytes
+        est_bytes = 0
+        if watermark > 0:
+            try:
+                est_bytes = engine.estimated_run_bytes(data)
+            except Exception:  # noqa: BLE001 — unsized source: no gate
+                est_bytes = 0
         try:
-            if limit > 0:
+            if limit > 0 or (watermark > 0 and est_bytes > 0):
                 tokens = [engine.cancel]
                 if shutdown_installed():
                     tokens.append(shutdown_token())
                 admission_controller().acquire(
-                    limit, budget=engine.budget, tokens=tokens
+                    limit,
+                    budget=engine.budget,
+                    tokens=tokens,
+                    estimated_bytes=est_bytes,
+                    watermark_bytes=watermark,
                 )
                 admitted = True
             return AnalysisRunner._do_admitted_run(
@@ -230,7 +247,7 @@ class AnalysisRunner:
             )
         finally:
             if admitted:
-                admission_controller().release()
+                admission_controller().release(est_bytes)
             engine.budget, engine.cancel = prev_budget, prev_cancel
 
     @staticmethod
@@ -591,6 +608,7 @@ def _run_fused_pass(
                 states[len(units) + len(dense):],
                 isolate=True,
                 cancel=engine.cancel,
+                oom_probe=oom_probe_of(data),
             )
         )
     for plan, run in deferred.items():

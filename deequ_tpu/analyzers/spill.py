@@ -2170,7 +2170,9 @@ def multihost_spill_frequencies(
         key_kind = "f64"
     else:
         key_kind = "f32"
-    host_bits = key_kind == "f64" and jax.default_backend() != "cpu"
+    host_bits = key_kind == "f64" and (
+        jax.default_backend() != "cpu" or _FORCE_HOST_F64_BITS
+    )
 
     pred = None
     pred_error: Optional[BaseException] = None

@@ -108,6 +108,32 @@ class Options:
     max_concurrent_runs: int = int(
         os.environ.get("DEEQU_TPU_MAX_CONCURRENT_RUNS", 0) or 0
     )
+    # memory-pressure resilience (engine/memory.py,
+    # docs/RESILIENCE.md "Memory pressure"): adaptive batch backoff —
+    # a batch whose dispatch/transfer OOMs is re-fed through a chunked
+    # path at a geometrically halved effective batch size; False
+    # restores the pre-backoff behavior (a device OOM aborts the scan)
+    memory_backoff: bool = (
+        os.environ.get("DEEQU_TPU_MEMORY_BACKOFF", "1") != "0"
+    )
+    # floor for the backed-off effective batch size; an allocation
+    # that still fails here quarantines the remaining rows instead
+    min_batch_rows: int = int(
+        os.environ.get("DEEQU_TPU_MIN_BATCH_ROWS", 4096)
+    )
+    # consecutive clean batches at a reduced size before the effective
+    # size heals back up (doubles); <= 0 disables healing (the scan
+    # stays at the reduced size until it ends)
+    memory_heal_after_batches: int = int(
+        os.environ.get("DEEQU_TPU_MEMORY_HEAL_AFTER", 8)
+    )
+    # admission high-watermark (bytes): concurrent runs queue once the
+    # sum of their estimated device footprints
+    # (engine.estimated_run_bytes, from scan_row_capacity geometry)
+    # would exceed this — queueing instead of co-OOMing; 0 disables
+    memory_watermark_bytes: int = int(
+        os.environ.get("DEEQU_TPU_MEMORY_WATERMARK_BYTES", 0) or 0
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -501,23 +501,51 @@ class AdmissionController:
     waiters arbitrarily — ticket order makes queueing fair and
     testable). Waiters poll in short real intervals so a queued run's
     own :class:`RunBudget` (possibly on a fake clock) and cancel token
-    stay live while it waits."""
+    stay live while it waits.
+
+    High-watermark gate (docs/RESILIENCE.md "Memory pressure"): with
+    ``watermark_bytes`` set, a run also queues while admitting its
+    ``estimated_bytes`` (engine.estimated_run_bytes, from the scan's
+    row-capacity geometry) would push the byte sum of ACTIVE runs past
+    the watermark — concurrent runs queue instead of co-OOMing. A
+    single run larger than the whole watermark still admits when
+    nothing else is active (it must run eventually; backoff is its
+    safety net)."""
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
         self._active = 0
+        self._active_bytes = 0
         self._queue: "deque[int]" = deque()
         self._next_ticket = 0
+
+    def _admissible(
+        self, limit: int, estimated_bytes: int, watermark_bytes: int
+    ) -> bool:
+        if limit > 0 and self._active >= limit:
+            return False
+        if (
+            watermark_bytes > 0
+            and estimated_bytes > 0
+            and self._active > 0
+            and self._active_bytes + estimated_bytes > watermark_bytes
+        ):
+            return False
+        return True
 
     def acquire(
         self,
         limit: int,
         budget: Optional[RunBudget] = None,
         tokens: Sequence[Optional[CancelToken]] = (),
+        estimated_bytes: int = 0,
+        watermark_bytes: int = 0,
     ) -> None:
-        """Block until admitted. Raises :class:`DeadlineExceeded` /
-        :class:`RunCancelled` if the run's envelope closes while it is
-        still queued — a run that cannot start in time must not start."""
+        """Block until admitted. ``limit <= 0`` means no concurrency
+        bound (the watermark alone gates). Raises
+        :class:`DeadlineExceeded` / :class:`RunCancelled` if the run's
+        envelope closes while it is still queued — a run that cannot
+        start in time must not start."""
         from deequ_tpu.telemetry import get_telemetry
 
         live = [t for t in tokens if t is not None]
@@ -526,8 +554,11 @@ class AdmissionController:
             # spent queued counts against the deadline (idempotent —
             # the scan supervisor re-starting it later is a no-op)
         with self._cond:
-            if self._active < limit and not self._queue:
+            if not self._queue and self._admissible(
+                limit, estimated_bytes, watermark_bytes
+            ):
                 self._active += 1
+                self._active_bytes += max(0, int(estimated_bytes))
                 return
             ticket = self._next_ticket
             self._next_ticket += 1
@@ -535,7 +566,10 @@ class AdmissionController:
             get_telemetry().counter("engine.runs_queued").inc()
             try:
                 while not (
-                    self._queue[0] == ticket and self._active < limit
+                    self._queue[0] == ticket
+                    and self._admissible(
+                        limit, estimated_bytes, watermark_bytes
+                    )
                 ):
                     for token in live:
                         token.raise_if_cancelled()
@@ -547,20 +581,28 @@ class AdmissionController:
                     self._cond.wait(timeout=0.02)
                 self._queue.popleft()
                 self._active += 1
+                self._active_bytes += max(0, int(estimated_bytes))
             except BaseException:
                 if ticket in self._queue:
                     self._queue.remove(ticket)
                 self._cond.notify_all()
                 raise
 
-    def release(self) -> None:
+    def release(self, estimated_bytes: int = 0) -> None:
         with self._cond:
             self._active -= 1
+            self._active_bytes = max(
+                0, self._active_bytes - max(0, int(estimated_bytes))
+            )
             self._cond.notify_all()
 
     def snapshot(self) -> Dict[str, int]:
         with self._cond:
-            return {"active": self._active, "queued": len(self._queue)}
+            return {
+                "active": self._active,
+                "queued": len(self._queue),
+                "active_bytes": self._active_bytes,
+            }
 
 
 _ADMISSION = AdmissionController()

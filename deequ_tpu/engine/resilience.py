@@ -13,7 +13,12 @@ source batch is an independent fault domain:
 - transient-vs-deterministic taxonomy — IO/transfer errors
   (:class:`TransientScanError`, ``OSError`` and its timeout/connection
   subclasses) are retried; decode/shape errors are not (retrying a
-  deterministic failure just burns the backoff budget).
+  deterministic failure just burns the backoff budget). Allocation
+  failures are a THIRD class: ``engine/memory.py``'s
+  ``MemoryPressureError`` family is deliberately NOT transient
+  (re-dispatching the same batch at the same size re-OOMs) — the scan
+  loops route it to the adaptive batch backoff instead, and only its
+  terminal ``BackoffExhausted`` form reaches the quarantine path here.
 - :class:`ScanDegradation` — the provenance record a degraded scan
   carries: rows skipped, batches quarantined, error classes, one
   :class:`BatchFailure` per quarantined batch. Threaded through

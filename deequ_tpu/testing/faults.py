@@ -31,10 +31,31 @@ so a failing test replays byte-for-byte:
   batch is produced, before fault checks (the deterministic trigger for
   cancel-mid-scan tests: cancel a token at exactly batch k).
 
+Memory-pressure faults (engine/memory.py) fire through the engine's
+``oom_probe`` protocol — the engine calls ``probe(stage, index, rows)``
+INSIDE its guarded transfer/dispatch/finalize stages, so an injected
+OOM rides the exact classification path a live device allocation
+failure would, with zero real memory pressure:
+
+- ``oom_at_batch={index: n}`` (or a bare iterable, n=1) — the unit's
+  dispatch raises a simulated ``RESOURCE_EXHAUSTED`` for its first
+  ``n`` attempts at that index, then succeeds (raise-then-succeed:
+  backoff shrinks, the retried sub-batches pass);
+- ``oom_every_n=k`` — every k-th unit's dispatch OOMs once;
+- ``oom_rows_over=limit`` — ANY dispatch/transfer wider than ``limit``
+  rows OOMs: the natural geometric-backoff fault (the scan settles at
+  the first effective size <= limit; exact analog of a device that
+  fits only so many rows);
+- ``oom_transfer_at={index: n}`` — like ``oom_at_batch`` but fired at
+  the transfer (device_put) stage;
+- ``oom_finalize=n`` / ``oom_deferred=n`` — the first ``n``
+  collector-finalize / deferred-path probes OOM (the spill downgrade
+  chain in analyzers/grouping.py).
+
 The fault ledger (remaining transient raises, remaining hangs, one-shot
-slow delays, the kill flag) is SHARED across iterator restarts and
-re-runs of the same wrapper instance, mirroring a real flaky source
-that eventually serves the batch.
+slow delays, remaining OOMs, the kill flag) is SHARED across iterator
+restarts and re-runs of the same wrapper instance, mirroring a real
+flaky source that eventually serves the batch.
 """
 
 from __future__ import annotations
@@ -43,6 +64,7 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Set
 
 import numpy as np
 
+from deequ_tpu.engine.memory import simulated_device_oom
 from deequ_tpu.engine.resilience import (
     ScanKilled,
     ScanStalled,
@@ -74,6 +96,12 @@ class FaultInjectingDataset:
         on_batch: Optional[Dict[int, Callable[[], None]]] = None,
         clock: Optional[Any] = None,
         hang_tick_s: float = 0.25,
+        oom_at_batch: Optional[Any] = None,
+        oom_every_n: int = 0,
+        oom_rows_over: int = 0,
+        oom_transfer_at: Optional[Any] = None,
+        oom_finalize: int = 0,
+        oom_deferred: int = 0,
     ):
         self._inner = inner
         self._transient_remaining = dict(transient or {})
@@ -95,8 +123,68 @@ class FaultInjectingDataset:
         self._clock = clock
         self._hang_tick_s = float(hang_tick_s)
         self._interrupt_event: Optional[Any] = None
+        # memory-pressure ledgers ({index: n} or bare iterable, n=1)
+        self._oom_remaining = self._oom_spec(oom_at_batch)
+        self._oom_transfer_remaining = self._oom_spec(oom_transfer_at)
+        self._oom_every_n = int(oom_every_n)
+        self._oom_every_fired: Set[int] = set()
+        self._oom_rows_over = int(oom_rows_over)
+        self._oom_finalize_remaining = int(oom_finalize)
+        self._oom_deferred_remaining = int(oom_deferred)
         # observability for assertions: every fault actually fired
         self.faults_fired: list = []
+
+    @staticmethod
+    def _oom_spec(spec: Optional[Any]) -> Dict[int, int]:
+        if spec is None:
+            return {}
+        if isinstance(spec, dict):
+            return {int(k): int(v) for k, v in spec.items()}
+        return {int(i): 1 for i in spec}
+
+    def oom_probe(self, stage: str, index: int = 0, rows: int = 0) -> None:
+        """Engine protocol hook (engine/memory.py ``oom_probe_of``):
+        called inside the guarded transfer/dispatch/finalize stages
+        with the unit index and the dispatch width in rows; raises a
+        simulated XLA ``RESOURCE_EXHAUSTED`` when a configured
+        memory-pressure fault is due at that point."""
+
+        def fire():
+            self.faults_fired.append(("oom", stage, index, int(rows)))
+            raise simulated_device_oom(rows, f"{stage}@{index}")
+
+        if stage == "finalize":
+            if self._oom_finalize_remaining > 0:
+                self._oom_finalize_remaining -= 1
+                fire()
+            return
+        if stage == "deferred":
+            if self._oom_deferred_remaining > 0:
+                self._oom_deferred_remaining -= 1
+                fire()
+            return
+        # a device that fits only `limit` rows: any wider allocation
+        # fails, at full size AND at still-too-wide backed-off sizes —
+        # the scan settles at the first effective size <= limit
+        if self._oom_rows_over and rows > self._oom_rows_over:
+            fire()
+        ledger = (
+            self._oom_transfer_remaining
+            if stage == "transfer"
+            else self._oom_remaining
+        )
+        remaining = ledger.get(index, 0)
+        if remaining > 0:
+            ledger[index] = remaining - 1
+            fire()
+        if (
+            self._oom_every_n > 0
+            and stage == "dispatch"
+            and (index + 1) % self._oom_every_n == 0
+            and index not in self._oom_every_fired
+        ):
+            self._oom_every_fired.add(index)
+            fire()
 
     def attach_interrupt(self, event: Any) -> None:
         """Engine protocol hook: the scan supervisor hands the source an

@@ -230,15 +230,23 @@ def row_level_results(
                 inner = constraint
             if not isinstance(inner, AnalysisBasedConstraint):
                 continue
-            excluded = _where_pass(
-                getattr(inner.analyzer, "where", None), data
-            )
-            outcome = _outcome_for(
-                inner.analyzer,
-                data,
-                assertion=inner.assertion,
-                excluded=excluded,
-            )
+            try:
+                excluded = _where_pass(
+                    getattr(inner.analyzer, "where", None), data
+                )
+                outcome = _outcome_for(
+                    inner.analyzer,
+                    data,
+                    assertion=inner.assertion,
+                    excluded=excluded,
+                )
+            except Exception:  # noqa: BLE001 — degrade: an unplannable
+                # predicate (compile_predicate in _where_pass or the
+                # Compliance branch) drops THIS constraint's column
+                # only, mirroring _asserted_per_value's discipline; the
+                # aggregate path already reported the same exception as
+                # a FAILURE ConstraintResult
+                continue
             if outcome is None:
                 continue
             if excluded is None:

@@ -33,12 +33,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 N_ROWS = 10_000_000
+N_F64_ROWS = 2_000_000
+N_OVERFLOW_ROWS = 200_000
 TOP_K = 12
 
 WORKER = r"""
 import json, sys
 import numpy as np
 coordinator, pid, shard_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+f64_path, overflow_path = sys.argv[4], sys.argv[5]
 import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
@@ -53,7 +56,10 @@ from jax.sharding import Mesh
 
 from deequ_tpu import Dataset
 from deequ_tpu.analyzers.grouping import FrequencyPlan
-from deequ_tpu.analyzers.spill import multihost_spill_frequencies
+from deequ_tpu.analyzers import spill as spill_mod
+from deequ_tpu.analyzers.spill import (
+    SpillOverflow, multihost_spill_frequencies,
+)
 from deequ_tpu.analyzers import (
     CountDistinct, Distinctness, Entropy, Histogram, Uniqueness,
 )
@@ -99,6 +105,54 @@ out["histogram"] = {
 out["histogram_bins"] = dist.number_of_bins
 if int(pid) == 0:
     print("METRICS " + json.dumps(out), flush=True)
+
+# ---- scenario 2: f64 keys, host-packed canonical bits --------------
+# the same coordinator pair (no second jax.distributed init) runs the
+# shuffle over an f64 key column with the host bit-packing forced —
+# the path a TPU backend takes (its X64 rewriter cannot lower the f64
+# bitcast), exercised here on CPU via the test hook
+f64_ds = Dataset.from_parquet(f64_path)
+spill_mod._FORCE_HOST_F64_BITS = True
+try:
+    f64_state = multihost_spill_frequencies(
+        f64_ds, FrequencyPlan(("k",), None, False), mesh
+    )
+finally:
+    spill_mod._FORCE_HOST_F64_BITS = False
+f64_out = {}
+for a in (CountDistinct("k"), Uniqueness("k"), Distinctness("k")):
+    m = a.compute_metric_from_state(f64_state)
+    assert m.value.is_success, (a, m.value)
+    f64_out[a.name] = m.value.get()
+if int(pid) == 0:
+    print("F64_METRICS " + json.dumps(f64_out), flush=True)
+
+# ---- scenario 3: forced SpillOverflow -> host Arrow fallback -------
+# a constant key column: every row of every device hashes to ONE
+# bucket, blowing past the static per-bucket capacity — SpillOverflow
+# must raise UNIFORMLY on both hosts (never a one-sided hang), and the
+# host Arrow fallback (local shard counts + one tiny allgather) still
+# produces exact frequencies
+ov_ds = Dataset.from_parquet(overflow_path)
+try:
+    multihost_spill_frequencies(
+        ov_ds, FrequencyPlan(("c",), None, False), mesh
+    )
+    raise AssertionError("expected SpillOverflow on the constant key")
+except SpillOverflow:
+    pass
+# fallback: exact local counts, merged with one scalar allgather
+from jax.experimental import multihost_utils
+vals = np.asarray(ov_ds.table.column("c").to_pylist(), dtype=np.int64)
+uniq, counts = np.unique(vals, return_counts=True)
+assert len(uniq) == 1
+merged = np.asarray(multihost_utils.process_allgather(
+    jax.numpy.asarray([int(counts[0])], dtype=jax.numpy.int64)
+)).reshape(-1)
+if int(pid) == 0:
+    print("OVERFLOW_FALLBACK " + json.dumps({
+        "key": int(uniq[0]), "total": int(merged.sum()),
+    }), flush=True)
 print(f"worker {pid} done", flush=True)
 """.replace("TOPK", str(TOP_K))
 
@@ -143,6 +197,38 @@ def _run(workdir: str) -> None:
         )
         shards.append(path)
 
+    # f64 scenario: wide-exponent doubles (incl. negatives and exact
+    # duplicates) so the canonical-bit packing's total order matters
+    f64_keys = np.round(rng.normal(0, 1e6, N_F64_ROWS), 3)
+    f64_keys[:: 7] = 42.125  # heavy duplicate
+    f64_table = pa.table({"k": pa.array(f64_keys, pa.float64())})
+    f64_split = int(N_F64_ROWS * 0.6)
+    f64_shards = []
+    for i, (off, length) in enumerate(
+        [(0, f64_split), (f64_split, N_F64_ROWS - f64_split)]
+    ):
+        path = os.path.join(workdir, f"f64shard{i}")
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(
+            f64_table.slice(off, length),
+            os.path.join(path, "part0.parquet"),
+        )
+        f64_shards.append(path)
+
+    # overflow scenario: a CONSTANT key — every row hashes to one
+    # bucket, guaranteeing SpillOverflow at any realistic capacity
+    ov_shards = []
+    for i, length in enumerate(
+        [N_OVERFLOW_ROWS // 2, N_OVERFLOW_ROWS - N_OVERFLOW_ROWS // 2]
+    ):
+        path = os.path.join(workdir, f"ovshard{i}")
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(
+            pa.table({"c": pa.array([7] * length, pa.int64())}),
+            os.path.join(path, "part0.parquet"),
+        )
+        ov_shards.append(path)
+
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -152,7 +238,8 @@ def _run(workdir: str) -> None:
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, coordinator, str(i), shards[i]],
+            [sys.executable, "-c", WORKER, coordinator, str(i),
+             shards[i], f64_shards[i], ov_shards[i]],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,
@@ -191,11 +278,17 @@ def _run(workdir: str) -> None:
         )
         raise RuntimeError(f"worker(s) {failed} failed:\n{report}")
 
-    got = None
+    got = got_f64 = got_overflow = None
     for line in outputs[0].decode().splitlines():
         if line.startswith("METRICS "):
             got = json.loads(line[len("METRICS "):])
+        elif line.startswith("F64_METRICS "):
+            got_f64 = json.loads(line[len("F64_METRICS "):])
+        elif line.startswith("OVERFLOW_FALLBACK "):
+            got_overflow = json.loads(line[len("OVERFLOW_FALLBACK "):])
     assert got is not None, outputs[0].decode()
+    assert got_f64 is not None, outputs[0].decode()
+    assert got_overflow is not None, outputs[0].decode()
 
     # ground truth: whole table, device spill DISABLED (host Arrow)
     from deequ_tpu import Dataset, config
@@ -247,6 +340,37 @@ def _run(workdir: str) -> None:
     for k in set(got["histogram"]) & set(want_hist):
         assert got["histogram"][k] == want_hist[k], k
     print(f"{'Histogram':>14}: multihost top-{TOP_K} == arrow")
+
+    # f64 ground truth: whole table, host path
+    f64_whole = Dataset.from_arrow(f64_table)
+    f64_analyzers = [
+        CountDistinct("k"), Uniqueness("k"), Distinctness("k"),
+    ]
+    with config.configure(device_spill_grouping=False):
+        ctx_f = AnalysisRunner.do_analysis_run(f64_whole, f64_analyzers)
+    for a in f64_analyzers:
+        want = ctx_f.metric(a).value.get()
+        have = got_f64[a.name]
+        assert abs(have - want) <= 1e-9 * max(1.0, abs(want)), (
+            a.name, have, want,
+        )
+        print(
+            f"{a.name + '/f64':>14}: multihost {have:.9g} "
+            f"== arrow {want:.9g}"
+        )
+    print(
+        "f64 host-packed-bits shuffle (2 processes): "
+        "f64 metrics == whole-table Arrow"
+    )
+
+    # overflow ground truth: the constant key, full count
+    assert got_overflow == {"key": 7, "total": N_OVERFLOW_ROWS}, (
+        got_overflow
+    )
+    print(
+        "constant-key bucket overflow (2 processes): "
+        "spill overflow -> host fallback == whole-table"
+    )
     print(
         "multi-host grouping (2 processes, loopback, device shuffle): "
         "metrics == whole-table Arrow"

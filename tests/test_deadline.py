@@ -306,7 +306,9 @@ class TestAdmissionController:
         t1.join(timeout=5)
         t2.join(timeout=5)
         assert order == [1, 2]
-        assert ctl.snapshot() == {"active": 0, "queued": 0}
+        assert ctl.snapshot() == {
+            "active": 0, "queued": 0, "active_bytes": 0,
+        }
 
     def test_queued_run_expires_under_its_deadline(self):
         ctl = AdmissionController()

@@ -28,9 +28,15 @@ def test_cross_host_grouping_shuffle_equals_whole_table():
     #3): two real processes, one global mesh, 10M rows with ~9.7M
     distinct keys split 60/40 — CountDistinct/Uniqueness/Distinctness/
     Entropy/Histogram through the bucketed all_to_all device shuffle
-    (NO Arrow fallback) must equal the whole-table host run. Delegates
-    to examples/multihost_grouping.py — the runnable demo IS the
-    test."""
+    (NO Arrow fallback) must equal the whole-table host run. The SAME
+    coordinator pair (one jax.distributed init) then runs two more
+    scenarios: f64 keys through the host-packed canonical-bit path
+    (what a TPU backend takes — forced on CPU via the test hook), and
+    a constant-key column that overflows a hash bucket, where
+    SpillOverflow must raise UNIFORMLY on both hosts (no one-sided
+    hang) and the host Arrow fallback still yields exact counts.
+    Delegates to examples/multihost_grouping.py — the runnable demo IS
+    the test."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.join(repo, "examples", "multihost_grouping.py")
     result = subprocess.run(
@@ -41,3 +47,7 @@ def test_cross_host_grouping_shuffle_equals_whole_table():
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "metrics == whole-table Arrow" in result.stdout
+    assert "f64 metrics == whole-table Arrow" in result.stdout
+    assert (
+        "spill overflow -> host fallback == whole-table" in result.stdout
+    )

@@ -635,12 +635,33 @@ class TestPredicateSoakSmoke:
         from tools.predicate_oracle import run_predicate_soak
 
         failures, skipped = run_predicate_soak(
-            40, seed=7, n_rows=150, verbose=False
+            200, seed=7, n_rows=150, verbose=False
         )
         assert not failures, failures[:3]
         # the generator emits only supported grammar: any plan-time
         # rejection means generator and compiler disagree on coverage
         assert skipped == 0
+
+    def test_boundary_fuzz_rejects_cleanly(self):
+        """The flip side of the soak: deliberately-UNSUPPORTED grammar
+        (unknown columns/functions, syntax junk, bad arity) through the
+        full Compliance planning path. Every expression must land as a
+        plan-time failure metric — no crash out of the runner, no
+        silent success."""
+        import os
+        import sys
+
+        sys.path.insert(
+            0,
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        from tools.predicate_oracle import run_boundary_fuzz
+
+        crashes, accepted = run_boundary_fuzz(
+            120, seed=11, n_rows=60, verbose=False
+        )
+        assert crashes == [], crashes[:2]
+        assert accepted == [], accepted[:5]
 
 
 class TestR5GrammarIntegration:

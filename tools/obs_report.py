@@ -82,6 +82,9 @@ def render_run(summary: Dict[str, Any]) -> str:
         "engine.deadline_exceeded",
         "engine.runs_cancelled",
         "engine.runs_queued",
+        "engine.oom_events",
+        "engine.batch_size_backoffs",
+        "engine.spill_downgrades",
     )
     if any(res_counters.get(k) for k in res_keys):
         lines.append("  resilience:")
@@ -110,6 +113,32 @@ def render_run(summary: Dict[str, Any]) -> str:
                     f" [batch={e.get('batch_index')},"
                     f" checkpointed={e.get('checkpointed')}]"
                 )
+            elif e.get("event") == "scan_memory_pressure":
+                action = e.get("action")
+                if action == "oom":
+                    lines.append(
+                        f"    memory pressure ({e.get('origin')}) at"
+                        f" {e.get('stage')} batch {e.get('batch_index')}"
+                        f" (rows={e.get('rows')})"
+                    )
+                elif action in ("backoff", "heal"):
+                    lines.append(
+                        f"    batch size {action}:"
+                        f" {e.get('from_rows')} ->"
+                        f" {e.get('effective_rows')} rows"
+                    )
+                elif action == "exhausted":
+                    lines.append(
+                        f"    backoff exhausted at batch"
+                        f" {e.get('batch_index')}"
+                        f" (floor={e.get('effective_rows')} rows)"
+                    )
+                elif action == "spill-downgrade":
+                    lines.append(
+                        f"    spill downgrade"
+                        f" ({','.join(e.get('columns', []))}):"
+                        f" {e.get('stage')} -> {e.get('path')}"
+                    )
 
     spills = [
         e for e in summary.get("events", [])

@@ -485,10 +485,103 @@ def run_predicate_soak(
     return failures, skipped
 
 
+# --------------------------------------------------------------------------
+# boundary fuzz: grammar the planner must REJECT, cleanly
+# --------------------------------------------------------------------------
+
+# each template yields an expression OUTSIDE the supported grammar —
+# unknown columns/functions, wrong arity, syntax junk, unsupported
+# cast targets. "{num}"/"{str}"/"{bool}" splice in random VALID
+# sub-expressions so the junk sits at realistic positions
+_UNSUPPORTED_TEMPLATES = [
+    "zz > {num}",  # unknown column
+    "FOO({num}) > 0",  # unknown function
+    "{num} >",  # dangling operator
+    "{num} > > 0",  # doubled operator
+    "({bool}",  # unbalanced paren
+    "{num} BETWEEN {num}",  # BETWEEN without AND
+    "{num} IN ()",  # empty IN list
+    "CAST({num} AS BLOB) > 0",  # unsupported cast target
+    "ABS({num}, {num}) > 0",  # wrong arity
+    "SUBSTR({str}) = 'a'",  # missing SUBSTR position
+    "{bool} AND",  # trailing conjunction
+    "{str} ||| {str} = 'ab'",  # unknown operator
+    "SELECT * FROM t",  # not a predicate at all
+]
+
+
+def gen_unsupported_predicate(rng) -> str:
+    template = _pick(rng, _UNSUPPORTED_TEMPLATES)
+    out = []
+    rest = template
+    while True:
+        idx = min(
+            (rest.find(m) for m in ("{num}", "{str}", "{bool}")
+             if rest.find(m) >= 0),
+            default=-1,
+        )
+        if idx < 0:
+            out.append(rest)
+            break
+        out.append(rest[:idx])
+        marker = rest[idx:idx + 6] if rest[idx:].startswith("{bool}") \
+            else rest[idx:idx + 5]
+        if marker == "{num}":
+            out.append(_gen_num(rng, 1))
+        elif marker == "{str}":
+            out.append(_gen_str(rng, 1))
+        else:
+            out.append(_gen_bool(rng, 1))
+        rest = rest[idx + len(marker):]
+    return "".join(out)
+
+
+def run_boundary_fuzz(
+    n_exprs: int, seed: int = 0, n_rows: int = 50, verbose: bool = True
+):
+    """Feed deliberately-unsupported grammar through the FULL
+    Compliance planning path. The contract is clean rejection: every
+    expression ends as a plan-time failure metric — never a crash out
+    of the runner, and (for the guaranteed-invalid templates) never a
+    silent success. Returns (crashes, accepted)."""
+    from deequ_tpu.analyzers import AnalysisRunner, Compliance
+
+    rng = np.random.default_rng(seed)
+    ds, _rows = make_soak_dataset(rng, n_rows)
+    crashes = []
+    accepted = []
+    exprs = [gen_unsupported_predicate(rng) for _ in range(n_exprs)]
+    chunk = 25
+    for lo in range(0, len(exprs), chunk):
+        sub = exprs[lo : lo + chunk]
+        analyzers = [
+            Compliance(f"u{lo + i}", e) for i, e in enumerate(sub)
+        ]
+        try:
+            ctx = AnalysisRunner.do_analysis_run(ds, analyzers)
+        except Exception as exc:  # noqa: BLE001 — the defect we hunt
+            crashes.append((sub, repr(exc)))
+            continue
+        for a, e in zip(analyzers, sub):
+            if ctx.metric(a).value.is_success:
+                accepted.append(e)
+                if verbose:
+                    print(f"ACCEPTED unsupported expr {e!r}")
+    if verbose:
+        print(
+            f"boundary fuzz: {len(exprs)} exprs, "
+            f"{len(crashes)} crashes, {len(accepted)} accepted"
+        )
+    return crashes, accepted
+
+
 if __name__ == "__main__":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     fails, _ = run_predicate_soak(n, seed=int(os.environ.get("SEED", 0)))
-    sys.exit(1 if fails else 0)
+    crashes, _accepted = run_boundary_fuzz(
+        max(50, n // 4), seed=int(os.environ.get("SEED", 0))
+    )
+    sys.exit(1 if (fails or crashes) else 0)

@@ -1,12 +1,19 @@
-"""Lint: hot-path modules must not roll their own timing/tracing.
+"""Lint: hot-path modules must not roll their own timing/tracing —
+or their own out-of-memory classification.
 
 All wall-clock attribution lives in ``deequ_tpu/telemetry/`` (spans,
 PhaseClock, pass timing) so trace names stay consistent with XProf and
 timings stay comparable across PRs. This tool tokenizes every module
 under the hot-path packages and flags ``time.perf_counter``,
 ``jax.profiler.start_trace``/``stop_trace``, and ``TraceAnnotation``
-references outside the telemetry layer. Run from the test suite
-(tests/test_telemetry.py) and by hand:
+references outside the telemetry layer.
+
+Likewise, all memory-pressure classification lives in
+``deequ_tpu/engine/memory.py`` (classify_memory_pressure): an ad-hoc
+``except MemoryError`` or a bare OOM marker string
+(``RESOURCE_EXHAUSTED`` / "out of memory") anywhere else in the hot
+path would fork the taxonomy — flagged the same way. Run from the
+test suite (tests/test_telemetry.py) and by hand:
 
     python -m tools.telemetry_lint [repo_root]
 """
@@ -41,12 +48,26 @@ FORBIDDEN_NAMES = frozenset(
 # the one place allowed to touch clocks and the profiler
 EXEMPT_PREFIX = "deequ_tpu/telemetry/"
 
+# NAME tokens that mean "module rolls its own OOM taxonomy" (the
+# MemoryPressureError family + classify_memory_pressure are fine —
+# different token)
+FORBIDDEN_OOM_NAMES = frozenset({"MemoryError"})
+
+# STRING-literal markers that mean "module string-matches allocator
+# failures itself" (lowercased containment check)
+FORBIDDEN_OOM_MARKERS = ("resource_exhausted", "out of memory")
+
+# the one classification point (engine/memory.py docstring)
+OOM_EXEMPT_FILES = frozenset({"deequ_tpu/engine/memory.py"})
+
 
 def find_violations(root: str) -> List[Tuple[str, int, str]]:
     """(relpath, line, token) for every forbidden NAME token in a
-    hot-path module. Tokenize-based: a mention in a comment or docstring
-    does not flag; an aliased import (``from time import perf_counter``)
-    does."""
+    hot-path module — own-timing names everywhere outside the telemetry
+    layer, plus ad-hoc OOM classification (``MemoryError`` NAME tokens,
+    OOM marker STRING literals) outside engine/memory.py. Tokenize-
+    based: a mention in a comment or docstring does not flag; an
+    aliased import (``from time import perf_counter``) does."""
     violations: List[Tuple[str, int, str]] = []
     for rel_dir in HOT_PATH_DIRS:
         top = os.path.join(root, rel_dir)
@@ -60,6 +81,7 @@ def find_violations(root: str) -> List[Tuple[str, int, str]]:
                 rel = os.path.relpath(path, root).replace(os.sep, "/")
                 if rel.startswith(EXEMPT_PREFIX):
                     continue
+                oom_exempt = rel in OOM_EXEMPT_FILES
                 with open(path, "rb") as fh:
                     source = fh.read()
                 try:
@@ -67,12 +89,26 @@ def find_violations(root: str) -> List[Tuple[str, int, str]]:
                         io.BytesIO(source).readline
                     )
                     for tok in tokens:
-                        if (
-                            tok.type == tokenize.NAME
-                            and tok.string in FORBIDDEN_NAMES
+                        if tok.type == tokenize.NAME and (
+                            tok.string in FORBIDDEN_NAMES
+                            or (
+                                not oom_exempt
+                                and tok.string in FORBIDDEN_OOM_NAMES
+                            )
                         ):
                             violations.append(
                                 (rel, tok.start[0], tok.string)
+                            )
+                        elif (
+                            tok.type == tokenize.STRING
+                            and not oom_exempt
+                            and any(
+                                marker in tok.string.lower()
+                                for marker in FORBIDDEN_OOM_MARKERS
+                            )
+                        ):
+                            violations.append(
+                                (rel, tok.start[0], "<oom marker string>")
                             )
                 except tokenize.TokenizeError:
                     violations.append((rel, 0, "<tokenize error>"))
