@@ -1035,10 +1035,20 @@ def main(argv=None):
     # gate: a config only starts when the remaining budget covers it,
     # so the overall wall stays under --budget instead of rc=124-ing
     # the harness (BENCH_r05)
+    # ORDER MATTERS (r6): the two wide-profiler configs run FIRST so
+    # the cell-rate headline fields (ns_per_cell_50col,
+    # projected_1b_x50_resident_8chip_s) exist even when the harness
+    # rc=124-kills the process partway through the slower tail configs
+    # — 4M x 50 is the round-over-round cell-rate headline, 8M x 50 is
+    # the scaling check the <60 s north-star verdict reads
     secondary = (
         []
         if args.quick
         else [
+            ("profiler_50col",
+             lambda: bench_profiler_wide(4_000_000, 50), 150),
+            ("profiler_50col_8m",
+             lambda: bench_profiler_wide(8_000_000, 50), 200),
             ("fused_bundle_10col",
              lambda: bench_fused_bundle(8_000_000), 60),
             ("grouping_5cat", lambda: bench_grouping(4_000_000), 60),
@@ -1051,8 +1061,6 @@ def main(argv=None):
              lambda: bench_memory_backoff_overhead(4_000_000), 90),
             ("watchdog_overhead",
              lambda: bench_watchdog_overhead(4_000_000), 90),
-            ("profiler_50col",
-             lambda: bench_profiler_wide(4_000_000, 50), 150),
             ("spill_grouping_12M_distinct",
              lambda: bench_spill_grouping(12_000_000), 120),
             ("joint_grouping_mi_1Mcard_pair",
@@ -1066,6 +1074,33 @@ def main(argv=None):
              lambda: bench_streaming_bundle_100m(), 330),
         ]
     )
+    def merge_wide(result: dict) -> dict:
+        # the 50-col cell-rate headline (VERDICT r4) plus the r6 8M
+        # scaling check: resident rate on the north-star-shaped config
+        # and its link-independent projection — the one number to
+        # compare round over round regardless of what the tunnel link
+        # did during the run. The 8M x 50 run supersedes 4M x 50 for
+        # the projection (amortizes per-step overhead the way a 1B run
+        # would); 4M x 50 remains the comparable-cell-rate field.
+        wide = detail.get("profiler_50col")
+        if isinstance(wide, dict) and "resident_rows_per_sec" in wide:
+            result["resident_rows_per_sec_50col"] = round(
+                wide["resident_rows_per_sec"], 1
+            )
+            result["ns_per_cell_50col"] = round(wide["ns_per_cell"], 2)
+            result["projected_1b_x50_resident_8chip_s"] = round(
+                wide["projected_1b_x50_resident_8chip_s"], 1
+            )
+        wide8 = detail.get("profiler_50col_8m")
+        if isinstance(wide8, dict) and "resident_rows_per_sec" in wide8:
+            result["ns_per_cell_50col_8m"] = round(
+                wide8["ns_per_cell"], 2
+            )
+            result["projected_1b_x50_resident_8chip_s"] = round(
+                wide8["projected_1b_x50_resident_8chip_s"], 1
+            )
+        return result
+
     for name, thunk, est_s in secondary:
         if remaining() < est_s:
             detail["skipped"].append(
@@ -1096,6 +1131,16 @@ def main(argv=None):
             file=sys.stderr,
             flush=True,
         )
+        if name in ("profiler_50col", "profiler_50col_8m"):
+            # re-emit the preliminary line the moment a wide config
+            # lands: the cell-rate/projection fields survive an rc=124
+            # kill during the remaining (slower) tail configs
+            print(
+                json.dumps(
+                    {**merge_wide(headline_line()), "preliminary": True}
+                ),
+                flush=True,
+            )
 
     # the process-wide telemetry picture of everything the bench ran:
     # counter totals + the pass-latency histogram (docs/OBSERVABILITY.md)
@@ -1104,20 +1149,7 @@ def main(argv=None):
     detail["telemetry"] = get_telemetry().metrics.snapshot()
     detail["total_wall_s"] = round(time.time() - start, 1)
 
-    result = headline_line()
-    # the 50-col cell-rate headline (VERDICT r4): resident rate on the
-    # north-star-shaped config plus its link-independent projection —
-    # the one number to compare round over round regardless of what
-    # the tunnel link did during the run
-    wide = detail.get("profiler_50col")
-    if isinstance(wide, dict) and "resident_rows_per_sec" in wide:
-        result["resident_rows_per_sec_50col"] = round(
-            wide["resident_rows_per_sec"], 1
-        )
-        result["ns_per_cell_50col"] = round(wide["ns_per_cell"], 2)
-        result["projected_1b_x50_resident_8chip_s"] = round(
-            wide["projected_1b_x50_resident_8chip_s"], 1
-        )
+    result = merge_wide(headline_line())
     print(json.dumps(detail, indent=2), file=sys.stderr)
     print(json.dumps(result))
 

@@ -65,6 +65,28 @@ class Options:
     # re-scan path — kept for differential testing and as an escape
     # hatch
     one_pass_spill: bool = True
+    # route the HLL register scatter-max through the measured unroll-16
+    # Pallas SMEM kernel (tools/scatter_probe.py: 1.1-1.15x over the XLA
+    # scatter at (2^21, M=2^14)) when the backend supports it; falls
+    # back to the XLA scatter automatically when Pallas/Mosaic is
+    # unavailable (CPU, old jax). Registers are bit-identical either
+    # way (tests/test_fastpath_differential.py). Off by default until
+    # the production-shape probe artifact justifies flipping it
+    # (docs/PERF.md "Pallas scatter")
+    pallas_scatter: bool = (
+        os.environ.get("DEEQU_TPU_PALLAS_SCATTER", "0") == "1"
+    )
+    # widened sorted-dedup HLL gate (sketches/hll.py): integer columns
+    # whose O(1) range probe FAILS (unknown or wide declared range) may
+    # still ride the shared KLL sort's sorted-dedup register builder
+    # when their carried-register cardinality estimate says
+    # mid-cardinality AND the batch's values fit the f32 mantissa
+    # (both checked in-kernel; a mispredicted estimate falls back to
+    # the full scatter inside the branch). False restores the
+    # range-probe-only gate — kept as the differential reference
+    hll_dedup_widening: bool = (
+        os.environ.get("DEEQU_TPU_HLL_DEDUP_WIDENING", "1") != "0"
+    )
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

@@ -145,6 +145,13 @@ def packed_device_get(tree: Any) -> Any:
             for name, parts in groups.items()
         }
     )
+    # bytes actually pulled over the link per sync — together with
+    # engine.device_fetches this is the sync-discipline audit surface
+    # (tests/test_sync_discipline.py pins fetches; dashboards trend
+    # bytes/fetch to catch a state blow-up before it costs seconds)
+    get_telemetry().counter("engine.fetch_bytes").inc(
+        int(sum(np.asarray(a).nbytes for a in packed.values()))
+    )
     out = list(leaves)
     for name, members in group_members.items():
         off = 0

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deequ_tpu import config
 from deequ_tpu.analyzers import states as S
 from deequ_tpu.analyzers.base import ScanOps, pad_pow2
 from deequ_tpu.analyzers.basic import (
@@ -96,6 +97,49 @@ def _stack_luts(luts: List[np.ndarray], fill=0) -> np.ndarray:
             for lut in luts
         ]
     )
+
+
+# --------------------------------------------------------------------------
+# shared per-batch prologue (cross-unit stack/sort memoization)
+# --------------------------------------------------------------------------
+#
+# Every unit in a fused step receives the SAME batch dict object — the
+# engine (scan.py fused_update / the resident scan body) builds it once
+# per trace and hands it to each op in turn — so the first unit to need
+# a stacked (C, B) block (or the KLL group's masked f32 sort, or the
+# where-filter row mask) stores the traced value back into the dict
+# under a reserved key and later units reuse it. Relying on XLA HLO CSE
+# for this worked for the sort (two structurally identical subgraphs)
+# but NOT for the per-family stacks and row masks, whose operand sets
+# differ across groups; at 40 columns the repeated prologue work was a
+# measured slice of the 2.4x in-engine KLL overhead (docs/PERF.md "KLL
+# unit decomposition"). The reserved prefix can never collide with wire
+# keys ("col::repr", "__buf_*", "__row_width__"), and the memo entries
+# live only for the duration of one trace (the dict dies with it).
+
+_SHARED_PREFIX = "__shared__:"
+
+
+def _shared_stack(batch, columns, suffix):
+    """Memoized ``jnp.stack([batch[f"{c}::{suffix}"] ...])``: one stack
+    per (column tuple, repr) per fused step, shared across units."""
+    key = _SHARED_PREFIX + suffix + ":" + "\x1f".join(columns)
+    out = batch.get(key)
+    if out is None:
+        out = jnp.stack([batch[f"{c}::{suffix}"] for c in columns])
+        batch[key] = out
+    return out
+
+
+def _shared_rows(batch, where_fn, where):
+    """Memoized ``_row_mask``: one row-validity vector per (batch,
+    where-expression) — every group with the same filter reuses it."""
+    key = _SHARED_PREFIX + "rows:" + repr(where)
+    out = batch.get(key)
+    if out is None:
+        out = _row_mask(batch, where_fn)
+        batch[key] = out
+    return out
 
 
 def _where_ok_for_token(where: Optional[str], dataset: Dataset) -> bool:
@@ -175,9 +219,9 @@ def _build_stats_group(
         return state
 
     def update(state, batch):
-        x = jnp.stack([batch[f"{c}::{repr_name}"] for c in columns])
-        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
-        masks = masks & _row_mask(batch, where_fn)[None, :]
+        x = _shared_stack(batch, columns, repr_name)
+        masks = _shared_stack(batch, columns, "mask")
+        masks = masks & _shared_rows(batch, where_fn, where)[None, :]
         new = dict(state)
         n_b = jnp.sum(masks, axis=1, dtype=jnp.int32).astype(jnp.int64)
         new["n"] = state["n"] + n_b
@@ -295,8 +339,8 @@ def _build_completeness_group(
         }
 
     def update(state, batch):
-        rows = _row_mask(batch, where_fn)
-        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        rows = _shared_rows(batch, where_fn, where)
+        masks = _shared_stack(batch, columns, "mask")
         valid = masks & rows[None, :]
         return {
             "matches": state["matches"]
@@ -336,14 +380,25 @@ def _build_hll_group(
     value_repr: str,  # "values" (numeric) | "codes" (string)
     where: Optional[str],
     kll_pool_columns: Optional[Tuple[str, ...]] = None,
+    runtime_gate_columns: Optional[Tuple[str, ...]] = None,
 ) -> ScanUnit:
     """``kll_pool_columns``: when a KLL group with the same ``where``
     shares the scan and covers this group's (f32-storage) columns, the
     planner passes the KLL group's column order — the update then
-    rebuilds the KLL sort via the SAME _kll_sorted_stack construction
-    (XLA CSE executes it once) and every column takes the sorted-dedup
-    register builder unconditionally: mid-cardinality columns win from
-    batch 1, high-cardinality ones pay only the unique-count probe."""
+    reuses the KLL sort via the SAME memoized _kll_sorted_stack (one
+    sort per step, shared through the batch dict) and every statically
+    qualified column takes the sorted-dedup register builder
+    unconditionally: mid-cardinality columns win from batch 1,
+    high-cardinality ones pay only the unique-count probe.
+
+    ``runtime_gate_columns``: the widened gate (config
+    .hll_dedup_widening) — pooled integer columns whose O(1) range
+    probe could NOT statically prove them; they dispatch per batch on
+    the carried-register cardinality estimate plus an in-kernel f32
+    mantissa-exactness check (sketches/hll.py
+    gated_column_registers_from_sorted), falling back to the plain
+    scatter whenever either check — or the inner U<=D probe — says
+    no."""
     columns, member_cols = _index_members(members)
     where_fn, where_reqs = _compile_where(where, dataset)
     requests = [
@@ -376,12 +431,12 @@ def _build_hll_group(
         )
 
     def update(state, batch, consts_in=None):
-        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
-        masks = masks & _row_mask(batch, where_fn)[None, :]
+        masks = _shared_stack(batch, columns, "mask")
+        masks = masks & _shared_rows(batch, where_fn, where)[None, :]
         if value_repr == "codes":
-            codes = jnp.stack(
-                [batch[f"{c}::codes"] for c in columns]
-            ).astype(jnp.int32)
+            codes = _shared_stack(batch, columns, "codes").astype(
+                jnp.int32
+            )
             lut1, lut2 = consts_in["h1"], consts_in["h2"]
             if lut1.shape[1] <= hll.PRESENCE_DICT_CAP:
                 # small dictionaries: presence compare-reduce + one
@@ -398,15 +453,23 @@ def _build_hll_group(
                     h1, h2, masks
                 )
         elif kll_pool_columns:
-            # rebuild the KLL group's sort with the shared
-            # construction; XLA CSE runs it ONCE for both units
+            # reuse the KLL group's sort through the shared-batch memo
+            # (one sort per step for both units, no CSE reliance)
             sorted_all, _, _ = _kll_sorted_stack(
-                batch, kll_pool_columns, where_fn
+                batch, kll_pool_columns, where_fn, where
             )
             row_of = {c: i for i, c in enumerate(kll_pool_columns)}
+            gated = frozenset(runtime_gate_columns or ())
             regs = jnp.stack(
                 [
-                    hll.dedup_column_registers_from_sorted(
+                    hll.gated_column_registers_from_sorted(
+                        sorted_all[row_of[c]],
+                        batch[f"{c}::values"],
+                        masks[i],
+                        state.registers[i],
+                    )
+                    if c in gated
+                    else hll.dedup_column_registers_from_sorted(
                         sorted_all[row_of[c]],
                         batch[f"{c}::values"],
                         masks[i],
@@ -415,7 +478,7 @@ def _build_hll_group(
                 ]
             )
         else:
-            x = jnp.stack([batch[f"{c}::values"] for c in columns])
+            x = _shared_stack(batch, columns, "values")
             # adaptive: sorted-dedup for mid-cardinality groups (gated
             # by the carried registers), full scatter otherwise
             regs = hll.numeric_registers_adaptive(
@@ -435,7 +498,7 @@ def _build_hll_group(
         dataset,
         columns,
         where,
-        extra=(value_repr, kll_pool_columns),
+        extra=(value_repr, kll_pool_columns, runtime_gate_columns),
     )
     return ScanUnit(
         members,
@@ -456,21 +519,34 @@ def _build_hll_group(
 # --------------------------------------------------------------------------
 
 
-def _kll_sorted_stack(batch, columns, where_fn):
+def _kll_sorted_stack(batch, columns, where_fn, where=None):
     """THE one construction of the KLL group's masked f32 sort — also
-    consumed by the HLL sorted-dedup path when both units share a scan
-    (the two traces produce structurally IDENTICAL subgraphs, so XLA's
-    HLO CSE executes the sort once; a drift between two hand-written
-    copies would silently double the sort cost, hence one function).
-    Returns (sorted_x (C, B), masks, x)."""
-    masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
-    masks = masks & _row_mask(batch, where_fn)[None, :]
+    consumed by the HLL sorted-dedup path when both units share a scan.
+    Memoized into the shared batch dict (keyed by columns + where), so
+    the two units PROVABLY run one sort per step — previously both
+    emitted the construction and XLA HLO CSE was trusted to merge the
+    structurally identical subgraphs, which held only while nothing
+    perturbed either copy. Returns (sorted_x (C, B), masks, x)."""
+    key = (
+        _SHARED_PREFIX
+        + "kllsort:"
+        + repr(where)
+        + ":"
+        + "\x1f".join(columns)
+    )
+    hit = batch.get(key)
+    if hit is not None:
+        return hit
+    masks = _shared_stack(batch, columns, "mask")
+    masks = masks & _shared_rows(batch, where_fn, where)[None, :]
     x = jnp.stack(
         [batch[f"{c}::values"].astype(jnp.float32) for c in columns]
     )
     masks = masks & jnp.isfinite(x)
     sorted_x = jnp.sort(jnp.where(masks, x, jnp.inf), axis=1)
-    return sorted_x, masks, x
+    out = (sorted_x, masks, x)
+    batch[key] = out
+    return out
 
 
 def _build_kll_group(
@@ -510,7 +586,9 @@ def _build_kll_group(
     def update(_state, batch):
         # mirrors analyzers/kll._make_kll_ops exactly, vectorized over
         # the column axis; the device kernel stays in f32/u32 lanes
-        sorted_x, masks, x = _kll_sorted_stack(batch, columns, where_fn)
+        sorted_x, masks, x = _kll_sorted_stack(
+            batch, columns, where_fn, where
+        )
         B = x.shape[1]
         nv = jnp.sum(masks, axis=1, dtype=jnp.int64)
         q = ((nv + k - 1) // k).astype(jnp.uint32)
@@ -537,13 +615,21 @@ def _build_kll_group(
 
     def host_fold(accs, out):
         samples, valid, nv, mn, mx, level = out
+        # one host-side conversion for the whole (C, k) block; valid
+        # samples are finite by construction (the device kernel masks
+        # non-finite values into the +inf sentinel and invalidates
+        # those slots), so the per-column isfinite net is skipped
+        samples = np.asarray(samples)  # sync-ok: host fold runs on
+        valid = np.asarray(valid)  # sync-ok: already-fetched numpy
+        # (the packed epilogue fetched the whole block)
         for i in range(C):
             accs[i].add_pre_compacted(
-                np.asarray(samples[i])[np.asarray(valid[i])],
+                samples[i][valid[i]],
                 int(level[i]),
                 int(nv[i]),
                 float(mn[i]),
                 float(mx[i]),
+                assume_finite=True,
             )
         return accs
 
@@ -614,12 +700,10 @@ def _build_datatype_group(
         from deequ_tpu.sketches.hll import PRESENCE_DICT_CAP
 
         table = consts_in["lut"]
-        rows = _row_mask(batch, where_fn)
-        masks = jnp.stack([batch[f"{c}::mask"] for c in columns])
+        rows = _shared_rows(batch, where_fn, where)
+        masks = _shared_stack(batch, columns, "mask")
         valid = masks & rows[None, :]
-        codes = jnp.stack(
-            [batch[f"{c}::codes"] for c in columns]
-        ).astype(jnp.int32)
+        codes = _shared_stack(batch, columns, "codes").astype(jnp.int32)
         if table.shape[1] <= PRESENCE_DICT_CAP:
             # shared single-source implementation — see
             # analyzers/datatype.py counts_from_code_presence
@@ -772,28 +856,31 @@ def plan_scan_units(
                 )
             elif key[0] == "hll":
                 pool = None
+                runtime_gated: Tuple[str, ...] = ()
                 pooled_members, plain_members = members, []
                 candidate = kll_pools.get(key[3])
                 if (
                     key[1] == "values"
                     and candidate is not None
-                    and key[2] in ("float32", "int8", "int16", "int32")
+                    and key[2]
+                    in ("float32", "int8", "int16", "int32", "int64")
                 ):
                     if key[2] == "float32":
                         cols, _ = _index_members(members)
                         if set(cols) <= set(candidate):
                             pool = candidate
                     else:
-                        # integer storage rides the f32-cast pool only
-                        # when the column's RANGE both fits the 24-bit
-                        # mantissa (cast exact; dict entries cast back
-                        # before the integral hash — sketches/hll.py)
-                        # and BOUNDS the cardinality near the dict
-                        # cap, so guaranteed-high-card key columns
-                        # keep the one stacked scatter instead of
-                        # per-column probes. Coverage is judged per
-                        # POOLED column (an unbounded group-mate must
-                        # not veto its bounded neighbors).
+                        # integer storage rides the f32-cast pool
+                        # STATICALLY when the column's RANGE both fits
+                        # the 24-bit mantissa (cast exact; dict entries
+                        # cast back before the integral hash —
+                        # sketches/hll.py) and BOUNDS the cardinality
+                        # near the dict cap, so guaranteed-high-card
+                        # key columns keep the one stacked scatter
+                        # instead of per-column probes. Coverage is
+                        # judged per POOLED column (an unbounded
+                        # group-mate must not veto its bounded
+                        # neighbors).
                         lim = 4 * hll.DEDUP_DICT_CAP
                         exact = 1 << 24  # f32 mantissa
                         cand_set = set(candidate)
@@ -813,6 +900,31 @@ def plan_scan_units(
                             for i, a in enumerate(members)
                             if poolable(a.column)
                         }
+                        # widened gate: KLL-covered integer columns
+                        # the probe could NOT qualify (unknown/wide
+                        # range) still join the pooled unit, gated at
+                        # RUNTIME on the carried-register estimate +
+                        # an in-batch mantissa check (sketches/hll.py
+                        # gated_column_registers_from_sorted) — the
+                        # probe's range is a cardinality PROXY; the
+                        # registers measure cardinality directly
+                        if config.options().hll_dedup_widening:
+                            gated_idx = {
+                                i
+                                for i, a in enumerate(members)
+                                if i not in pooled_idx
+                                and a.column in cand_set
+                            }
+                            if gated_idx:
+                                seen = set()
+                                runtime_gated = tuple(
+                                    a.column
+                                    for i, a in enumerate(members)
+                                    if i in gated_idx
+                                    and a.column not in seen
+                                    and not seen.add(a.column)
+                                )
+                                pooled_idx |= gated_idx
                         if pooled_idx:
                             pool = candidate
                             pooled_members = [
@@ -845,6 +957,7 @@ def plan_scan_units(
                             key[1],
                             key[3],
                             kll_pool_columns=pool,
+                            runtime_gate_columns=runtime_gated or None,
                         )
                     )
                 units.extend(new_units)

@@ -129,6 +129,11 @@ def registers_from_hash_pair(
     rho comes from h2's leading zeros (1..33) — supporting max register
     rank 33, ample for cardinalities far beyond 2^40."""
     idx, rho = _index_and_rank(h1, h2, mask)
+    from deequ_tpu.sketches import pallas_scatter
+
+    pallas = pallas_scatter.scatter_max(idx[None, :], rho[None, :], M)
+    if pallas is not None:
+        return pallas[0].astype(REGISTER_DTYPE)
     return (
         jnp.zeros(M, dtype=jnp.int32)
         .at[idx]
@@ -142,8 +147,15 @@ def registers_from_hash_pair_stacked(
 ) -> jnp.ndarray:
     """Column-stacked variant: (C, B) hash pairs -> (C, M) registers via
     ONE scatter-max into a flat (C*M,) vector (per-column register
-    blocks indexed by col*M + idx)."""
+    blocks indexed by col*M + idx). Behind ``config.pallas_scatter``
+    the unroll-16 SMEM kernel takes over with a (C, G) grid (a flat
+    C*M register file exceeds SMEM) — bit-identical either way."""
     idx, rho = _index_and_rank(h1, h2, mask)
+    from deequ_tpu.sketches import pallas_scatter
+
+    pallas = pallas_scatter.scatter_max(idx, rho, M)
+    if pallas is not None:
+        return pallas.astype(REGISTER_DTYPE)
     n_cols = idx.shape[0]
     col_ids = jax.lax.broadcasted_iota(jnp.int32, idx.shape, 0)
     flat = (col_ids * M + idx).ravel()
@@ -375,6 +387,45 @@ def dedup_column_registers_from_sorted(
         return _scatter_column(xc, maskc)
 
     return jax.lax.cond(U <= D, dict_path, scatter_path)
+
+
+def gated_column_registers_from_sorted(
+    s: jnp.ndarray,  # (B,) shared-pool sorted f32 keys for this column
+    xc: jnp.ndarray,  # (B,) raw values
+    maskc: jnp.ndarray,  # (B,) validity
+    prev_registers: jnp.ndarray,  # (M,) carried state for this column
+) -> jnp.ndarray:
+    """Runtime-widened sorted-dedup dispatch for ONE column the planner
+    could NOT statically qualify (the O(1) range probe failed, or the
+    declared range was too wide to prove anything). The column still
+    rides the shared KLL sort — already paid for — and takes the dict
+    path only when BOTH runtime checks pass:
+
+    - the carried-register linear-counting estimate says
+      mid-cardinality (``dedup_gate``), and
+    - for integer data, every valid value in THIS batch fits the f32
+      24-bit mantissa, so the pool's f32 sort keys are exact and the
+      dict entries round-trip to the raw dtype bit-identically.
+
+    Correctness never depends on the gate being right: a mispredicted
+    estimate (actual batch U > D) falls back to the scatter INSIDE
+    dedup_column_registers_from_sorted, and a non-qualifying batch
+    pays only the two cheap checks on top of its plain scatter."""
+    gate = dedup_gate(prev_registers)
+    if jnp.issubdtype(xc.dtype, jnp.floating):
+        qualifies = gate
+    else:
+        lim = 1 << 24  # f32 mantissa: int casts are exact in ±2^24
+        xi = xc.astype(jnp.int64)
+        in_mantissa = jnp.all(
+            jnp.where(maskc, (xi >= -lim) & (xi <= lim), True)
+        )
+        qualifies = gate & in_mantissa
+    return jax.lax.cond(
+        qualifies,
+        lambda: dedup_column_registers_from_sorted(s, xc, maskc),
+        lambda: _scatter_column(xc, maskc),
+    )
 
 
 def registers_from_sorted_dedup_stacked(

@@ -1,0 +1,205 @@
+"""Pallas scatter-max backend for the HLL register build.
+
+tools/scatter_probe.py measured the XLA register scatter at ~145 M
+elem/s across every formulation and found one Pallas variant that
+beats it: a single SMEM stream of packed ``idx << 6 | rho`` words,
+unroll-16 scalar loop, skip-cold stores (1.1-1.15x at B=2^21,
+M=2^14 — docs/PERF.md "Pallas scatter kernel probe"). This module
+ports that kernel behind ``config.pallas_scatter`` and generalizes it
+to the production shape: C columns scattered per fused-scan step.
+
+Layout constraints (probed on the real chip, encoded here):
+
+- the register file must live in SMEM (scalar VMEM stores are
+  unsupported by Mosaic), and SMEM is small — a flat (C*M,) register
+  file for C=40 would need 2.6 MB, so the kernel runs a (C, G) grid
+  with ONE (1, M) = 64 KB register block per column, revisited across
+  the G chunk steps (grid iterates the last dimension fastest);
+- BlockSpec index maps must return i32 (x64 is on; a literal 0 traces
+  as i64 and Mosaic fails to legalize the index map);
+- inputs stream as (1, CHUNK) SMEM blocks (grid-pipelined DMA).
+
+The dispatch contract: :func:`scatter_max` returns ``None`` whenever
+the Pallas path is off or unavailable and the caller (sketches/hll.py)
+falls back to the XLA scatter. Availability is probed ONCE per process
+by compiling AND running a tiny kernel end-to-end — Mosaic failures
+surface at compile time, not trace time, so executing is the only
+reliable probe. On CPU the probe fails fast and everything falls back;
+set ``DEEQU_TPU_PALLAS_INTERPRET=1`` to run the kernel through the
+Pallas interpreter instead (slow, but lets the CPU differential tests
+exercise the real kernel logic — tests/test_fastpath_differential.py).
+
+Both paths are bit-identical: max is commutative/associative and the
+padded tail scatters ``rho=0`` into register 0, a no-op against the
+zero-initialized file.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu import config
+
+# packed words streamed per grid step: 32 KB of SMEM at i32, the
+# probe's best chunk (c13); shorter batches use the next power of two
+CHUNK = 1 << 13
+# probe's best unroll: elements per fori iteration
+UNROLL = 16
+
+
+def _interpret_forced() -> bool:
+    return os.environ.get("DEEQU_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _tracing() -> bool:
+    """True while inside a jit trace — the availability probe must run
+    a real kernel, which is impossible mid-trace."""
+    try:
+        from jax import core
+
+        return not core.trace_state_clean()
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def _make_call(cols: int, g: int, chunk: int, unroll: int, m: int,
+               interpret: bool):
+    """Build the (C, G)-grid packed scatter-max pallas_call:
+    (cols, g*chunk) i32 packed words -> (cols, m) i32 registers."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(packed_ref, reg_ref):
+        # fresh column block: zero the register file before the first
+        # chunk lands (the block is revisited for all g of this column)
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            def z(i, _):
+                reg_ref[0, i] = jnp.int32(0)
+                return jnp.int32(0)
+
+            jax.lax.fori_loop(jnp.int32(0), jnp.int32(m), z, jnp.int32(0))
+
+        def body(i, _):
+            base = i * jnp.int32(unroll)
+            for u in range(unroll):
+                w = packed_ref[0, base + u]
+                r = jax.lax.shift_right_logical(w, jnp.int32(6))
+                v = jnp.bitwise_and(w, jnp.int32(63))
+                cur = reg_ref[0, r]
+
+                @pl.when(v > cur)
+                def _store():
+                    reg_ref[0, r] = v
+
+            return jnp.int32(0)
+
+        jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(chunk // unroll), body, jnp.int32(0)
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid=(cols, g),
+        in_specs=[
+            pl.BlockSpec(
+                (1, chunk), lambda c, gg: (c, gg), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, m), lambda c, gg: (c, jnp.int32(0)), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((cols, m), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _scatter_max_call(idx, rho, m: int, interpret: bool):
+    """(C, B) i32 idx/rho -> (C, m) i32 registers via the kernel,
+    padding B up to a chunk multiple with (idx=0, rho=0) no-ops."""
+    cols, b = idx.shape
+    chunk = max(UNROLL, min(CHUNK, _pow2_at_least(b)))
+    bp = -(-b // chunk) * chunk
+    packed = jnp.bitwise_or(
+        jnp.left_shift(idx.astype(jnp.int32), 6), rho.astype(jnp.int32)
+    )
+    if bp != b:
+        packed = jnp.pad(packed, ((0, 0), (0, bp - b)))
+    call = _make_call(cols, bp // chunk, chunk, UNROLL, m, interpret)
+    return call(packed)
+
+
+# probe verdict per interpret mode; populated lazily, reset by tests
+_PROBE: Dict[bool, bool] = {}
+
+
+def available() -> bool:
+    """Can the kernel compile and run on this backend? Probed once
+    end-to-end with a tiny shape; never probes mid-trace (returns
+    False without caching so a later eager call can still succeed)."""
+    interpret = _interpret_forced()
+    hit = _PROBE.get(interpret)
+    if hit is not None:
+        return hit
+    if _tracing():
+        return False
+    if not interpret:
+        try:
+            if jax.default_backend() != "tpu":
+                _PROBE[interpret] = False
+                return False
+        except Exception:
+            _PROBE[interpret] = False
+            return False
+    try:
+        m = 8
+        idx = (jnp.arange(64, dtype=jnp.int32) % m).reshape(2, 32)
+        rho = jnp.full((2, 32), 1, jnp.int32)
+        out = np.asarray(_scatter_max_call(idx, rho, m, interpret))
+        ok = out.shape == (2, m) and bool((out == 1).all())
+    except Exception:
+        ok = False
+    _PROBE[interpret] = ok
+    return ok
+
+
+def enabled() -> bool:
+    return bool(config.options().pallas_scatter) and available()
+
+
+def impl_token() -> str:
+    """Static plan fingerprint: which scatter backend a freshly traced
+    plan would bake in. Rides the engine plan-cache key (and the
+    vectorized HLL group token) so a flag flip retraces instead of
+    aliasing a stale compile."""
+    return "pallas" if enabled() else "xla"
+
+
+def scatter_max(idx, rho, m: int):
+    """Per-column scatter-max of ``rho`` into ``idx`` buckets:
+    (C, B) i32 -> (C, m) i32, or ``None`` when the Pallas path is
+    off/unavailable (caller falls back to the XLA scatter). idx must
+    be in [0, m), rho in [0, 64) — the HLL builder guarantees both
+    (idx is P hash bits, rho <= 33; masked rows map to (0, 0))."""
+    if not enabled():
+        return None
+    return _scatter_max_call(idx, rho, m, _interpret_forced())
+
+
+def _reset_probe_for_tests() -> None:
+    _PROBE.clear()
+    _make_call.cache_clear()

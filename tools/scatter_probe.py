@@ -21,11 +21,21 @@ Mosaic constraints discovered here (and encoded in the variants):
   as SMEM blocks (small chunks, grid-pipelined DMA).
 
 Run:  python tools/scatter_probe.py [--b 21] [--reps 8] [--iters 3]
+
+Production-shape mode (``--prod``): the fused-scan shape the engine
+actually dispatches — C=40 columns x B=2^21 rows x M=2^14 registers —
+timed as the STACKED scatter (one flat XLA scatter-max, exactly
+sketches/hll.registers_from_hash_pair_stacked's formulation) against
+the wired (C, G)-grid Pallas kernel (sketches/pallas_scatter.py, the
+same code ``config.pallas_scatter`` enables). Emits one
+machine-parseable line prefixed ``PROD_JSON:`` so the flag's default
+can be justified from an artifact instead of a doc table.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -306,13 +316,116 @@ def fetch_forced(run, args, iters):
     return min(samples)
 
 
+def xla_scatter_stacked(regs, idx, rho):
+    """(C, B) -> (C, M) via the flat stacked scatter-max — the exact
+    XLA formulation of hll.registers_from_hash_pair_stacked."""
+    n_cols = idx.shape[0]
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, idx.shape, 0)
+    flat = (col_ids * M + idx).ravel()
+    return jnp.maximum(
+        regs,
+        jnp.zeros(n_cols * M, jnp.int32)
+        .at[flat]
+        .max(rho.ravel())
+        .reshape(n_cols, M),
+    )
+
+
+def make_pallas_stacked():
+    """The PRODUCTION kernel: sketches/pallas_scatter's (C, G)-grid
+    unroll-16 packed variant — what config.pallas_scatter wires in."""
+    from deequ_tpu.sketches import pallas_scatter as ps
+
+    def fn(regs, idx, rho):
+        out = ps._scatter_max_call(idx, rho, M, ps._interpret_forced())
+        return jnp.maximum(regs, out)
+
+    return fn
+
+
+def prod_mode(args) -> None:
+    """C=40 x B=2^b x M production shape; prints a PROD_JSON line."""
+    C, B = args.cols, 1 << args.b
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, M, (C, B), dtype=np.int32))
+    rho = jnp.asarray(
+        np.minimum(rng.geometric(0.5, (C, B)).astype(np.int32), 33)
+    )
+    regs0 = jnp.zeros((C, M), jnp.int32)
+    idx_same = jnp.zeros((C, B), jnp.int32)
+
+    print(f"prod shape: C={C}, B=2^{args.b}, M={M}, reps={args.reps}")
+    null = chained(lambda r, i, v: jnp.maximum(r, 0), args.reps)
+    rt = fetch_forced(null, (regs0, idx, rho), args.iters)
+    print(f"round-trip baseline: {rt * 1e3:.1f} ms")
+
+    record = {
+        "mode": "prod",
+        "C": C,
+        "b_log2": args.b,
+        "M": M,
+        "reps": args.reps,
+        "backend": jax.default_backend(),
+        "roundtrip_ms": rt * 1e3,
+        "variants": {},
+    }
+    want = want_same = None
+    for name, fn in (
+        ("xla_stacked", xla_scatter_stacked),
+        ("pallas_stacked_u16", make_pallas_stacked()),
+    ):
+        try:
+            run = chained(fn, args.reps)
+            got = np.asarray(run(regs0, idx, rho))
+            got_same = np.asarray(run(regs0, idx_same, rho))
+            if want is None:
+                want, want_same = got, got_same
+                ok = True
+            else:
+                ok = bool(
+                    (got == want).all() and (got_same == want_same).all()
+                )
+            wall = fetch_forced(run, (regs0, idx, rho), args.iters) - rt
+            per_op = wall / args.reps
+            rate = C * B / per_op / 1e6
+            record["variants"][name] = {
+                "bit_identical": ok,
+                "per_op_ms": per_op * 1e3,
+                "m_elem_per_s": rate,
+            }
+            print(
+                f"{name:>24}: {per_op * 1e3:8.2f} ms/op  "
+                f"{rate:8.1f} M elem/s  "
+                f"[{'OK' if ok else 'WRONG'}]"
+            )
+        except Exception as e:  # noqa: BLE001 — probe tool
+            msg = str(e).splitlines()[0][:160]
+            record["variants"][name] = {"error": msg}
+            print(f"{name:>24}: FAILED {type(e).__name__}: {msg}")
+    xla = record["variants"].get("xla_stacked", {})
+    pallas = record["variants"].get("pallas_stacked_u16", {})
+    if "per_op_ms" in xla and "per_op_ms" in pallas:
+        record["pallas_speedup"] = xla["per_op_ms"] / pallas["per_op_ms"]
+    print("PROD_JSON: " + json.dumps(record))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--b", type=int, default=B_LOG2_DEFAULT)
     ap.add_argument("--reps", type=int, default=8)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--chunks", type=str, default="11,13")
+    ap.add_argument(
+        "--prod",
+        action="store_true",
+        help="production-shape stacked probe (C x 2^b x M) + JSON line",
+    )
+    ap.add_argument("--cols", type=int, default=40)
     args = ap.parse_args()
+
+    if args.prod:
+        prod_mode(args)
+        return
 
     B = 1 << args.b
     rng = np.random.default_rng(0)

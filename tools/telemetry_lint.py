@@ -1,5 +1,5 @@
 """Lint: hot-path modules must not roll their own timing/tracing —
-or their own out-of-memory classification.
+or their own out-of-memory classification, or their own device syncs.
 
 All wall-clock attribution lives in ``deequ_tpu/telemetry/`` (spans,
 PhaseClock, pass timing) so trace names stay consistent with XProf and
@@ -12,8 +12,19 @@ Likewise, all memory-pressure classification lives in
 ``deequ_tpu/engine/memory.py`` (classify_memory_pressure): an ad-hoc
 ``except MemoryError`` or a bare OOM marker string
 (``RESOURCE_EXHAUSTED`` / "out of memory") anywhere else in the hot
-path would fork the taxonomy — flagged the same way. Run from the
-test suite (tests/test_telemetry.py) and by hand:
+path would fork the taxonomy — flagged the same way.
+
+Sync discipline (the r6 rule): inside ``deequ_tpu/engine/`` the ONE
+sanctioned host<->device fetch is the packed epilogue
+(``engine/pack.py`` ``packed_device_get``) — a stray ``device_get`` or
+``asarray`` in a scan hot loop is a per-batch tunnel round trip, the
+exact regression class the 2-syncs-per-profile pin exists to prevent
+(tests/test_sync_discipline.py). ``device_get``/``asarray`` NAME
+tokens in engine modules outside pack.py are flagged unless the line
+carries an inline ``# sync-ok: <reason>`` waiver documenting why the
+call is host-side or a deliberate, clock-attributed sync (checkpoint
+drain, mesh epilogue). Run from the test suite
+(tests/test_telemetry.py) and by hand:
 
     python -m tools.telemetry_lint [repo_root]
 """
@@ -60,14 +71,24 @@ FORBIDDEN_OOM_MARKERS = ("resource_exhausted", "out of memory")
 # the one classification point (engine/memory.py docstring)
 OOM_EXEMPT_FILES = frozenset({"deequ_tpu/engine/memory.py"})
 
+# NAME tokens that mean "module syncs with the device on its own"
+# inside the engine layer; every legitimate use is either in pack.py
+# (the packed epilogue) or carries a same-line `# sync-ok:` waiver
+FORBIDDEN_SYNC_NAMES = frozenset({"device_get", "asarray"})
+SYNC_HOT_PREFIX = "deequ_tpu/engine/"
+SYNC_EXEMPT_FILES = frozenset({"deequ_tpu/engine/pack.py"})
+SYNC_WAIVER_MARKER = "sync-ok:"
+
 
 def find_violations(root: str) -> List[Tuple[str, int, str]]:
     """(relpath, line, token) for every forbidden NAME token in a
     hot-path module — own-timing names everywhere outside the telemetry
-    layer, plus ad-hoc OOM classification (``MemoryError`` NAME tokens,
-    OOM marker STRING literals) outside engine/memory.py. Tokenize-
-    based: a mention in a comment or docstring does not flag; an
-    aliased import (``from time import perf_counter``) does."""
+    layer, ad-hoc OOM classification (``MemoryError`` NAME tokens, OOM
+    marker STRING literals) outside engine/memory.py, and engine-layer
+    device syncs (``device_get``/``asarray``) outside pack.py without a
+    same-line ``# sync-ok:`` waiver. Tokenize-based: a mention in a
+    comment or docstring does not flag; an aliased import (``from time
+    import perf_counter``) does."""
     violations: List[Tuple[str, int, str]] = []
     for rel_dir in HOT_PATH_DIRS:
         top = os.path.join(root, rel_dir)
@@ -82,36 +103,56 @@ def find_violations(root: str) -> List[Tuple[str, int, str]]:
                 if rel.startswith(EXEMPT_PREFIX):
                     continue
                 oom_exempt = rel in OOM_EXEMPT_FILES
+                sync_checked = rel.startswith(
+                    SYNC_HOT_PREFIX
+                ) and rel not in SYNC_EXEMPT_FILES
                 with open(path, "rb") as fh:
                     source = fh.read()
                 try:
-                    tokens = tokenize.tokenize(
-                        io.BytesIO(source).readline
+                    tokens = list(
+                        tokenize.tokenize(io.BytesIO(source).readline)
                     )
-                    for tok in tokens:
-                        if tok.type == tokenize.NAME and (
-                            tok.string in FORBIDDEN_NAMES
-                            or (
-                                not oom_exempt
-                                and tok.string in FORBIDDEN_OOM_NAMES
-                            )
-                        ):
-                            violations.append(
-                                (rel, tok.start[0], tok.string)
-                            )
-                        elif (
-                            tok.type == tokenize.STRING
-                            and not oom_exempt
-                            and any(
-                                marker in tok.string.lower()
-                                for marker in FORBIDDEN_OOM_MARKERS
-                            )
-                        ):
-                            violations.append(
-                                (rel, tok.start[0], "<oom marker string>")
-                            )
                 except tokenize.TokenizeError:
                     violations.append((rel, 0, "<tokenize error>"))
+                    continue
+                # lines waived for the sync rule by an inline comment
+                waived = {
+                    tok.start[0]
+                    for tok in tokens
+                    if tok.type == tokenize.COMMENT
+                    and SYNC_WAIVER_MARKER in tok.string
+                }
+                for tok in tokens:
+                    if tok.type == tokenize.NAME and (
+                        tok.string in FORBIDDEN_NAMES
+                        or (
+                            not oom_exempt
+                            and tok.string in FORBIDDEN_OOM_NAMES
+                        )
+                    ):
+                        violations.append(
+                            (rel, tok.start[0], tok.string)
+                        )
+                    elif (
+                        tok.type == tokenize.NAME
+                        and sync_checked
+                        and tok.string in FORBIDDEN_SYNC_NAMES
+                        and tok.start[0] not in waived
+                    ):
+                        violations.append(
+                            (rel, tok.start[0], tok.string)
+                        )
+                    elif (
+                        tok.type == tokenize.STRING
+                        and not oom_exempt
+                        and any(
+                            marker in tok.string.lower()
+                            for marker in FORBIDDEN_OOM_MARKERS
+                        )
+                    ):
+                        violations.append(
+                            (rel, tok.start[0], "<oom marker string>")
+                        )
     return violations
 
 
@@ -122,11 +163,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     violations = find_violations(root)
     for rel, line, token in violations:
-        print(f"{rel}:{line}: {token} outside deequ_tpu/telemetry/")
+        print(f"{rel}:{line}: forbidden hot-path token {token}")
     if violations:
         print(
             f"{len(violations)} violation(s): timing/tracing belongs in "
-            "the telemetry layer (docs/OBSERVABILITY.md)"
+            "the telemetry layer (docs/OBSERVABILITY.md); engine syncs "
+            "belong in the packed epilogue (engine/pack.py) or need a "
+            "'# sync-ok:' waiver"
         )
         return 1
     print("telemetry lint clean")

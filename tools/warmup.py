@@ -29,6 +29,16 @@ the same STATIC decisions production data will:
 ``--suite`` additionally warms a VerificationSuite-shaped plan
 (completeness/uniqueness/compliance per column) on top of the default
 ColumnProfiler plan.
+
+Two engine options are part of the plan fingerprint (r6) and get their
+own warm pass automatically when they would change the compiled
+program: ``pallas_scatter`` (the plan-cache key carries the resolved
+impl token, so the Pallas-scatter program is distinct — warmed only
+where the kernel is actually available, i.e. on a TPU host) and
+``hll_dedup_widening`` (off compiles the scatter-only pooled HLL unit
+instead of the runtime-gated ``lax.cond`` unit — warmed whenever the
+schema has an int column, so a production flag-flip never eats a
+compile).
 """
 
 from __future__ import annotations
@@ -212,22 +222,45 @@ def main() -> int:
         "low": (False,), "high": (True,), "both": (False, True)
     }[args.string_cardinality]
     has_int64 = any(k == "int64" for k in schema.values())
+    has_int = any(k in ("int32", "int64") for k in schema.values())
     has_string = any(k == "string" for k in schema.values())
-    with config.configure(batch_size=batch):
-        total = 0.0
-        for nullable in nullables:
-            for wide in widths if has_int64 else (False,):
-                for high_card in cards if has_string else (False,):
-                    t = warm_once(
-                        schema, rows, nullable, wide, args.suite,
-                        high_card_strings=high_card,
-                    )
-                    total += t
-                    print(
-                        f"  warmed nullable={nullable} "
-                        f"wide_ints={wide} "
-                        f"high_card_strings={high_card}: {t:.1f}s"
-                    )
+
+    # engine-option variants that change the compiled program (each is
+    # a distinct plan-cache fingerprint; see engine/scan.py
+    # _plan_cache_key). The default pass warms
+    # (xla scatter, widening on); extra passes only run when they
+    # would actually compile something different on THIS host/schema.
+    from deequ_tpu.sketches import pallas_scatter
+
+    engine_variants = [{}]
+    if has_int:
+        # dedup-gate branch: widening off is the scatter-only pooled
+        # HLL unit — warm it so flipping the escape hatch in
+        # production is free
+        engine_variants.append({"hll_dedup_widening": False})
+    with config.configure(pallas_scatter=True):
+        if pallas_scatter.impl_token() == "pallas":
+            engine_variants.append({"pallas_scatter": True})
+
+    total = 0.0
+    for variant in engine_variants:
+        tag = (
+            " ".join(f"{k}={v}" for k, v in variant.items()) or "default"
+        )
+        with config.configure(batch_size=batch, **variant):
+            for nullable in nullables:
+                for wide in widths if has_int64 else (False,):
+                    for high_card in cards if has_string else (False,):
+                        t = warm_once(
+                            schema, rows, nullable, wide, args.suite,
+                            high_card_strings=high_card,
+                        )
+                        total += t
+                        print(
+                            f"  warmed [{tag}] nullable={nullable} "
+                            f"wide_ints={wide} "
+                            f"high_card_strings={high_card}: {t:.1f}s"
+                        )
     print(
         f"done in {total:.1f}s — plans persisted to "
         f"{config.options().compilation_cache_dir}; the first "
