@@ -829,6 +829,134 @@ def _probe_link_mb_per_sec() -> float:
     return worst
 
 
+def bench_service_concurrent_suites(
+    num_rows: int = 2_000_000, clients: int = 8
+):
+    """Multi-tenant service throughput (PR 7, docs/SERVICE.md): N
+    clients across two tenants with mixed priorities verify ONE shared
+    dataset key through a warm ``VerificationService``. Prices the
+    whole service path — queue, scheduler, shared dataset cache, plan
+    reuse — against the same suite run back-to-back directly. Reports
+    recompiles-after-warmup (must be 0), dataset placements (must be
+    1), and queue-wait p50/p99."""
+    import threading
+
+    import pyarrow as pa
+
+    from deequ_tpu import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.service import (
+        Priority,
+        RunRequest,
+        VerificationService,
+    )
+    from deequ_tpu.telemetry import get_telemetry
+
+    schema = {
+        "k1": "int64",
+        "k2": "int64",
+        "v1": "float32",
+        "v2": "float32",
+    }
+
+    def make():
+        rng = np.random.default_rng(5)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "k1": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "k2": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "v1": rng.normal(0, 1, num_rows).astype(np.float32),
+                    "v2": rng.normal(0, 1, num_rows).astype(np.float32),
+                }
+            )
+        )
+
+    def checks():
+        return [
+            Check(CheckLevel.ERROR, "bench-suite")
+            .is_complete("k1")
+            .is_complete("v1")
+            .is_non_negative("k2")
+        ]
+
+    tm = get_telemetry()
+    svc = VerificationService(workers=2, interactive_reserve=1).start()
+    try:
+        warm_wall = time.time()
+        svc.warmup(
+            schema,
+            checks=checks(),
+            profile=False,
+            nullable=(False,),
+            wide_ints=(True,),
+            batch_size=min(num_rows, 1 << 21),
+            engine_variants=[{}],
+        )
+        warm_wall = time.time() - warm_wall
+        compiles_before = tm.counter("engine.plan_cache.misses").value
+        placements_before = tm.counter(
+            "service.dataset_cache.misses"
+        ).value
+
+        handles = []
+        t0 = time.time()
+        for i in range(clients):
+            handles.append(
+                svc.submit(
+                    RunRequest(
+                        tenant="analytics" if i % 2 else "risk",
+                        checks=checks(),
+                        dataset_key="bench/shared",
+                        dataset_factory=make,
+                        priority=(
+                            Priority.BATCH
+                            if i % 2
+                            else Priority.INTERACTIVE
+                        ),
+                    )
+                )
+            )
+        threads = [
+            threading.Thread(target=h.wait, args=(600,))
+            for h in handles
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.time() - t0
+
+        waits = sorted(
+            h.started_at - h.submitted_at for h in handles
+        )
+        compiles = (
+            tm.counter("engine.plan_cache.misses").value
+            - compiles_before
+        )
+        placements = (
+            tm.counter("service.dataset_cache.misses").value
+            - placements_before
+        )
+        return {
+            "clients": clients,
+            "rows": num_rows,
+            "warmup_wall_s": round(warm_wall, 3),
+            "wall_s": round(wall, 3),
+            "runs_per_sec": round(clients / wall, 3) if wall else 0.0,
+            "recompiles_after_warmup": compiles,
+            "dataset_placements": placements,
+            "queue_wait_p50_s": round(waits[len(waits) // 2], 4),
+            "queue_wait_p99_s": round(waits[-1], 4),
+        }
+    finally:
+        svc.stop(drain=False, timeout=30)
+
+
 def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
     """BASELINE.json config 2 at its SPECIFIED scale, streamed:
     Mean/StdDev/Min/Max/Compliance over 10 numeric f32 columns,
@@ -1061,6 +1189,8 @@ def main(argv=None):
              lambda: bench_memory_backoff_overhead(4_000_000), 90),
             ("watchdog_overhead",
              lambda: bench_watchdog_overhead(4_000_000), 90),
+            ("service_concurrent_suites",
+             lambda: bench_service_concurrent_suites(2_000_000, 8), 90),
             ("spill_grouping_12M_distinct",
              lambda: bench_spill_grouping(12_000_000), 120),
             ("joint_grouping_mi_1Mcard_pair",

@@ -467,34 +467,43 @@ def _check_preconditions(
         return wrap_if_necessary(exc)
 
 
-def _run_fused_pass(
+@dataclass
+class FusedPassPlan:
+    """The planned (not yet executed) fused pass: vectorized scan
+    units, grouping family plans, the combined ``(adapter, ops)`` scan
+    pairs ready for ``engine.run_scan``, and the failure metrics
+    planning already produced. First-class so a caller (the service's
+    warm path, a future plan registry) can plan once, inspect the
+    engine-level ``ScanPlan`` it induces, and execute later — the
+    compile/execute split at the runner layer."""
+
+    metrics: Dict[Analyzer, Metric]
+    units: List[Any]
+    by_plan: Dict[Any, List[Analyzer]]
+    dense: List[Any]
+    collectors: List[Any]
+    deferred: Dict[Any, Any]
+    scan_pairs: List[Tuple[Any, Any]]
+
+    @property
+    def empty(self) -> bool:
+        return not self.scan_pairs and not self.deferred
+
+
+def _plan_fused_pass(
     data: Dataset,
     analyzers: List[ScanShareableAnalyzer],
     grouping: List[GroupingAnalyzer],
     engine: AnalysisEngine,
-    aggregate_with,
-    save_states_with,
     metadata=None,
-) -> Dict[Analyzer, Metric]:
-    """Plan + run THE fused scan: scan-shareable analyzers (vectorized
-    into stacked group ops, engine/vectorize.py), dense grouping
-    frequency plans (scatter-add ScanOps, analyzers/grouping.py), AND
-    high-cardinality spill plans (one-pass key collectors,
-    analyzers/spill.py) all ride one engine.run_scan — one pass over
-    the data, one packed state fetch, then every spill plan's sort
-    finalize dispatched before any result is fetched so the per-plan
-    sorts overlap. Only host-Arrow fallbacks (and collectors disabled
-    via config.one_pass_spill) re-read the source. Per-analyzer plan
-    failures (bad
-    predicate, unknown column inside an expression) degrade to failure
-    metrics without aborting the shared pass; each vectorized member's
-    ordinary state is sliced back out afterwards, so persistence/merge
-    semantics are identical to the single path."""
+) -> FusedPassPlan:
+    """Phase 1 of the fused pass: vectorize the scan-shareable
+    analyzers, plan the grouping frequency passes, and assemble the
+    scan pairs. Per-analyzer plan failures (bad predicate, unknown
+    column inside an expression) become failure metrics here without
+    aborting the shared pass."""
     from deequ_tpu.analyzers.grouping import (
         FrequencyScanAdapter,
-        finalize_collector_states,
-        finalize_dense_states,
-        finalize_grouping_metrics,
         plan_frequency_passes,
         plans_for,
     )
@@ -533,8 +542,71 @@ def _run_fused_pass(
             for spec in collectors
         ]
     )
-    if not scan_pairs and not deferred:
-        return metrics
+    return FusedPassPlan(
+        metrics=metrics,
+        units=units,
+        by_plan=by_plan,
+        dense=dense,
+        collectors=collectors,
+        deferred=deferred,
+        scan_pairs=scan_pairs,
+    )
+
+
+def _run_fused_pass(
+    data: Dataset,
+    analyzers: List[ScanShareableAnalyzer],
+    grouping: List[GroupingAnalyzer],
+    engine: AnalysisEngine,
+    aggregate_with,
+    save_states_with,
+    metadata=None,
+) -> Dict[Analyzer, Metric]:
+    """Plan + run THE fused scan: scan-shareable analyzers (vectorized
+    into stacked group ops, engine/vectorize.py), dense grouping
+    frequency plans (scatter-add ScanOps, analyzers/grouping.py), AND
+    high-cardinality spill plans (one-pass key collectors,
+    analyzers/spill.py) all ride one engine.run_scan — one pass over
+    the data, one packed state fetch, then every spill plan's sort
+    finalize dispatched before any result is fetched so the per-plan
+    sorts overlap. Only host-Arrow fallbacks (and collectors disabled
+    via config.one_pass_spill) re-read the source. Plan failures
+    degrade to failure metrics without aborting the shared pass; each
+    vectorized member's ordinary state is sliced back out afterwards,
+    so persistence/merge semantics are identical to the single path.
+    Composes ``_plan_fused_pass`` + ``_execute_fused_pass`` — the
+    runner-layer compile/execute split."""
+    pass_plan = _plan_fused_pass(data, analyzers, grouping, engine, metadata)
+    if pass_plan.empty:
+        return pass_plan.metrics
+    return _execute_fused_pass(
+        pass_plan, data, engine, aggregate_with, save_states_with, metadata
+    )
+
+
+def _execute_fused_pass(
+    pass_plan: FusedPassPlan,
+    data: Dataset,
+    engine: AnalysisEngine,
+    aggregate_with,
+    save_states_with,
+    metadata=None,
+) -> Dict[Analyzer, Metric]:
+    """Phase 2: drive a planned fused pass — the shared scan, state
+    slicing/persistence, grouping finalize, deferred spill fallbacks."""
+    from deequ_tpu.analyzers.grouping import (
+        finalize_collector_states,
+        finalize_dense_states,
+        finalize_grouping_metrics,
+    )
+
+    metrics = pass_plan.metrics
+    units = pass_plan.units
+    by_plan = pass_plan.by_plan
+    dense = pass_plan.dense
+    collectors = pass_plan.collectors
+    deferred = pass_plan.deferred
+    scan_pairs = pass_plan.scan_pairs
 
     states = None
     if scan_pairs:

@@ -156,6 +156,34 @@ class Options:
     memory_watermark_bytes: int = int(
         os.environ.get("DEEQU_TPU_MEMORY_WATERMARK_BYTES", 0) or 0
     )
+    # multi-tenant verification service (deequ_tpu/service/,
+    # docs/SERVICE.md): executor worker threads draining the run queue
+    service_workers: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_WORKERS", 2)
+    )
+    # of those, how many only ever take INTERACTIVE-class runs — the
+    # anti-starvation reserve (a long BATCH run can never occupy every
+    # worker); clamped to service_workers - 1 so batch work always has
+    # at least one worker
+    service_interactive_reserve: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_INTERACTIVE_RESERVE", 1)
+    )
+    # bytes watermark for the service's shared resident-dataset
+    # registry (service/caches.py DatasetCache): registered handles are
+    # evicted LRU-first once the sum of their estimated run bytes
+    # exceeds this; 0 = fall back to device_cache_bytes
+    service_dataset_watermark_bytes: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_DATASET_WATERMARK", 0) or 0
+    )
+    # per-tenant quotas: max runs a tenant may have queued+active at
+    # once (submit raises QuotaExceeded beyond it), and max
+    # simultaneously ACTIVE; 0 = unlimited
+    service_tenant_max_pending: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_TENANT_MAX_PENDING", 0) or 0
+    )
+    service_tenant_max_active: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_TENANT_MAX_ACTIVE", 0) or 0
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

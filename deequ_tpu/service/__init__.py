@@ -1,0 +1,55 @@
+"""Multi-tenant verification service (docs/SERVICE.md).
+
+PRs 3-6 built every primitive a long-lived verification server needs —
+FIFO admission with a bytes watermark, deadlines, cooperative cancel,
+SIGTERM draining, checkpoint/resume, quarantine degradation, warm plan
+precompilation — but only reachable one ``run()`` at a time from one
+caller. This package composes them into the always-on daemon the paper
+pitches (Schelter et al., PVLDB 11(12): a SHARED platform many teams
+submit suites to):
+
+- ``RunQueue`` + ``Scheduler``: thread-safe submissions from many
+  concurrent clients, priority classes with an anti-starvation
+  interactive reserve, per-tenant quotas, deadline-aware dequeue;
+- ``DatasetCache``: one device placement per shared table, however
+  many tenants verify it, with bytes-watermark LRU eviction;
+- ``PlanCache``: the service-level view over the engine's cross-run
+  jitted plan cache — warmed at startup via ``tools/warmup.py``, so
+  steady state recompiles nothing;
+- ``VerificationService``: the facade — ``submit()`` returns a
+  ``RunHandle`` (poll/wait/cancel; results carry degradation and
+  interruption provenance exactly like a direct run).
+
+Clock discipline: NO module here may call ``time.time``/``time.sleep``
+directly (enforced by tools/telemetry_lint.py) — all timing rides the
+injectable clocks from ``engine/deadline.py`` so every scheduling
+behavior is testable on fake time. Execution always goes through the
+runner's admission layer, never ``engine.run_scan`` directly (also
+lint-enforced).
+"""
+
+from deequ_tpu.service.caches import DatasetCache, PlanCache
+from deequ_tpu.service.queue import (
+    Priority,
+    QuotaExceeded,
+    RunHandle,
+    RunQueue,
+    RunState,
+    RunTicket,
+)
+from deequ_tpu.service.scheduler import Scheduler
+from deequ_tpu.service.service import RunRequest, VerificationService
+
+__all__ = [
+    "DatasetCache",
+    "PlanCache",
+    "Priority",
+    "QuotaExceeded",
+    "RunHandle",
+    "RunQueue",
+    "RunState",
+    "RunTicket",
+    "RunRequest",
+    "Scheduler",
+    "VerificationService",
+]

@@ -1,0 +1,207 @@
+"""Shared caches: resident datasets and warm compiled plans.
+
+**DatasetCache** — the tenancy multiplier for device memory. The
+engine already caches device chunks PER ``Dataset`` OBJECT (re-scans
+of the same handle replay cached chunks with zero transfers;
+data/table.py); what N concurrent tenants need is to reach the SAME
+handle for the same table. This registry maps a caller-chosen key
+(table name, parquet path, fingerprint) to one shared ``Dataset``, so
+N tenants verifying one table pay ONE ``device_put`` total. Admission
+awareness: each entry is weighed at registration with
+``engine.scan.estimated_run_bytes`` — the same coarse estimate the
+admission watermark gates on — and the registry evicts LRU-first past
+its bytes watermark, never evicting a handle currently leased by an
+active run (pin counts).
+
+**PlanCache** — the service-level ledger over the engine's cross-run
+jitted plan cache (engine/scan.py ``_PLAN_CACHE``). The engine cache
+does the actual sharing; this ledger answers the operator's questions:
+which plan tokens were warmed at startup, how many runs hit warm plans
+vs recompiled, is steady state really compile-free (the acceptance
+criterion "zero recompiles after warmup"). It reads per-run counter
+DELTAS from telemetry run summaries, so it composes with any executor
+that wraps runs in ``telemetry.run()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deequ_tpu.telemetry import get_telemetry
+
+
+class DatasetCache:
+    """Key -> shared resident ``Dataset`` handle, LRU + bytes
+    watermark, pin-counted leases."""
+
+    def __init__(self, watermark_bytes: int = 0):
+        self.watermark_bytes = int(watermark_bytes)
+        self._lock = threading.Lock()
+        # key -> (dataset, estimated_bytes, pins)
+        self._entries: "OrderedDict[str, List[Any]]" = OrderedDict()
+
+    def _tm(self):
+        return get_telemetry()
+
+    def lease(
+        self, key: str, factory: Callable[[], Any]
+    ) -> Tuple[Any, bool]:
+        """The shared handle for ``key`` (building it via ``factory``
+        on first use), pinned until ``release(key)``. Returns
+        ``(dataset, hit)``."""
+        tm = self._tm()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                entry[2] += 1
+                tm.counter("service.dataset_cache.hits").inc()
+                return entry[0], True
+        # build OUTSIDE the lock (factories read parquet, synthesize
+        # tables); racing builders are reconciled below — first one in
+        # wins, the loser's handle is dropped before any device bytes
+        # are placed (placement happens at first scan, not construction)
+        dataset = factory()
+        from deequ_tpu.engine.scan import estimated_run_bytes
+
+        try:
+            est = int(estimated_run_bytes(dataset))
+        except Exception:  # noqa: BLE001 — unsized source: weightless
+            est = 0
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                entry[2] += 1
+                tm.counter("service.dataset_cache.hits").inc()
+                return entry[0], True
+            self._entries[key] = [dataset, est, 1]
+            tm.counter("service.dataset_cache.misses").inc()
+            self._evict_locked()
+            self._set_bytes_gauge_locked()
+        return dataset, False
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry[2] = max(0, entry[2] - 1)
+            self._evict_locked()
+            self._set_bytes_gauge_locked()
+
+    def _evict_locked(self) -> None:
+        if self.watermark_bytes <= 0:
+            return
+        tm = self._tm()
+        while self._bytes_locked() > self.watermark_bytes:
+            victim = next(
+                (
+                    k
+                    for k, (_ds, _b, pins) in self._entries.items()
+                    if pins == 0
+                ),
+                None,
+            )
+            if victim is None:
+                return  # everything pinned: over watermark but safe
+            dataset, est, _ = self._entries.pop(victim)
+            try:
+                dataset.clear_device_cache()
+            except Exception:  # noqa: BLE001 — eviction is best-effort
+                pass
+            tm.counter("service.dataset_cache.evictions").inc()
+            tm.event(
+                "service_dataset_evicted",
+                dataset_key=victim,
+                estimated_bytes=est,
+            )
+
+    def _bytes_locked(self) -> int:
+        return sum(e[1] for e in self._entries.values())
+
+    def _set_bytes_gauge_locked(self) -> None:
+        self._tm().metrics.gauge("service.dataset_cache.bytes").set(
+            self._bytes_locked()
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "entries": {
+                    k: {"estimated_bytes": b, "pins": p}
+                    for k, (_ds, b, p) in self._entries.items()
+                },
+                "total_bytes": self._bytes_locked(),
+                "watermark_bytes": self.watermark_bytes,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            for dataset, _b, _p in self._entries.values():
+                try:
+                    dataset.clear_device_cache()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._entries.clear()
+            self._set_bytes_gauge_locked()
+
+
+class PlanCache:
+    """Warm-plan ledger: tokens warmed at startup + per-run hit/compile
+    accounting from telemetry run-summary counter deltas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._warmed: List[str] = []
+        self._runs = 0
+        self._warm_runs = 0
+        self._recompile_runs = 0
+
+    def note_warmed(self, tokens) -> None:
+        tm = get_telemetry()
+        with self._lock:
+            for token in tokens:
+                if token and token not in self._warmed:
+                    self._warmed.append(token)
+            n = len(self._warmed)
+        tm.metrics.gauge("service.plan_cache.warmed").set(n)
+        tm.event("service_plans_warmed", tokens=list(tokens))
+
+    def record_run(self, summary: Optional[Dict[str, Any]]) -> None:
+        """Fold one finished run's telemetry summary (counter DELTAS)
+        into the ledger: any ``engine.plan_cache.misses`` during the
+        run means it compiled something — a recompile-after-warmup in
+        steady state."""
+        counters = (summary or {}).get("counters", {}) or {}
+        hits = int(counters.get("engine.plan_cache.hits", 0))
+        misses = int(counters.get("engine.plan_cache.misses", 0))
+        tm = get_telemetry()
+        with self._lock:
+            self._runs += 1
+            if misses:
+                self._recompile_runs += 1
+            elif hits:
+                self._warm_runs += 1
+        if misses:
+            tm.counter("service.plan_cache.recompiles").inc(misses)
+        if hits:
+            tm.counter("service.plan_cache.warm_hits").inc(hits)
+
+    @property
+    def warmed_tokens(self) -> List[str]:
+        with self._lock:
+            return list(self._warmed)
+
+    def snapshot(self) -> Dict[str, Any]:
+        from deequ_tpu.engine.scan import plan_cache_snapshot
+
+        with self._lock:
+            return {
+                "warmed_tokens": list(self._warmed),
+                "runs": self._runs,
+                "warm_runs": self._warm_runs,
+                "recompile_runs": self._recompile_runs,
+                "engine_resident_plans": len(plan_cache_snapshot()),
+            }

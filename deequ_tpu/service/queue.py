@@ -1,0 +1,401 @@
+"""Run queue: priority classes, per-tenant quotas, deadline-aware pop.
+
+The queue is the service's ONLY ordering authority. It layers three
+policies on top of the engine's FIFO ``AdmissionController`` (which
+still gates device admission underneath, per run):
+
+- **priority classes** — INTERACTIVE < STANDARD < BATCH; ``pop``
+  always serves the best class first, FIFO by submission sequence
+  within a class (no starvation re-ordering inside a class);
+- **per-tenant quotas** — a tenant over its pending quota is refused
+  at ``push`` (``QuotaExceeded``); a tenant at its active quota is
+  SKIPPED at ``pop`` (its tickets stay queued, other tenants' work
+  proceeds — one noisy tenant cannot wedge the queue);
+- **envelope checks at pop** — a ticket whose deadline expired or
+  whose cancel token fired while queued is rejected/cancelled CLEANLY
+  at dequeue time (the terminal state lands on the handle; the
+  executor never sees it).
+
+Timing discipline: the queue never reads wall time itself — deadline
+expiry is asked of each ticket's ``RunBudget`` (which carries its own
+injectable clock), and queue-wait measurements use the ``clock`` handed
+to the queue. ``time.time``/``time.sleep`` are banned in this package
+(tools/telemetry_lint.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from deequ_tpu.engine.deadline import (
+    CancelToken,
+    DeadlineExceeded,
+    MonotonicClock,
+    RunBudget,
+    RunCancelled,
+)
+from deequ_tpu.telemetry import get_telemetry
+
+
+class Priority:
+    """Scheduling classes, best first. Integers (not an Enum) so
+    tickets order as plain tuples; anything in between is allowed but
+    these three are the service's vocabulary."""
+
+    INTERACTIVE = 0
+    STANDARD = 1
+    BATCH = 2
+
+    _NAMES = {0: "interactive", 1: "standard", 2: "batch"}
+
+    @staticmethod
+    def name(priority: int) -> str:
+        return Priority._NAMES.get(priority, str(priority))
+
+
+class QuotaExceeded(RuntimeError):
+    """A tenant tried to queue past its pending quota."""
+
+
+class RunState:
+    """Terminal + transitional states of a submitted run."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+
+    TERMINAL = frozenset({DONE, FAILED, CANCELLED, REJECTED})
+
+
+class RunHandle:
+    """The client's thread-safe view of one submitted run: poll
+    ``status``, block on ``result()``/``wait()``, ``cancel()`` at any
+    point. Exactly one terminal transition ever happens; ``result()``
+    re-raises the run's error for FAILED/REJECTED and ``RunCancelled``
+    for a run cancelled while still queued (a run cancelled while
+    RUNNING still returns its partial ``VerificationResult`` with
+    ``interruption`` set — same contract as a direct bounded run)."""
+
+    def __init__(self, run_id: str, tenant: str, priority: int):
+        self.run_id = run_id
+        self.tenant = tenant
+        self.priority = priority
+        self._state = RunState.QUEUED
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.cancel_token = CancelToken()
+        # scheduling timeline (service clock timestamps; filled by the
+        # queue/scheduler, surfaced in telemetry events)
+        self.submitted_at: Optional[float] = None
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    @property
+    def status(self) -> str:
+        return self._state
+
+    @property
+    def done(self) -> bool:
+        return self._state in RunState.TERMINAL
+
+    def cancel(self, reason: str = "cancelled by client") -> None:
+        """Cooperative cancel: while queued the ticket is dropped at
+        the next pop; while running the engine exits through its
+        checkpoint path and the handle completes with a partial
+        result."""
+        self.cancel_token.cancel(reason)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"run {self.run_id} not finished (status={self._state})"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # -- transitions (scheduler/queue internal) -------------------------
+
+    def _mark_running(self) -> None:
+        with self._lock:
+            if self._state == RunState.QUEUED:
+                self._state = RunState.RUNNING
+
+    def _finish(
+        self,
+        state: str,
+        result: Any = None,
+        error: Optional[BaseException] = None,
+    ) -> None:
+        with self._lock:
+            if self._state in RunState.TERMINAL:
+                return
+            self._state = state
+            self._result = result
+            self._error = error
+        self._done.set()
+
+    def __repr__(self) -> str:
+        return (
+            f"RunHandle({self.run_id}, tenant={self.tenant!r}, "
+            f"{Priority.name(self.priority)}, {self._state})"
+        )
+
+
+@dataclass
+class RunTicket:
+    """One queued unit of work: the handle the client holds, the
+    payload the executor needs, and the envelope (budget started at
+    SUBMIT — queue wait burns the deadline, matching the admission
+    controller's semantics)."""
+
+    seq: int
+    handle: RunHandle
+    payload: Any
+    budget: Optional[RunBudget] = None
+    estimated_bytes: int = 0
+    dataset_key: Optional[str] = None
+    submitted_at: float = 0.0
+
+    @property
+    def sort_key(self):
+        return (self.handle.priority, self.seq)
+
+
+class RunQueue:
+    """Thread-safe priority queue with tenant quotas. ``push`` from any
+    client thread; ``pop`` from executor workers (optionally restricted
+    to a maximum priority class — the interactive reserve). ``pop``
+    resolves queued-state terminations (deadline expired, cancelled)
+    as it scans, so dead tickets never reach an executor."""
+
+    def __init__(
+        self,
+        clock: Any = None,
+        tenant_max_pending: int = 0,
+        tenant_max_active: int = 0,
+    ):
+        self.clock = clock or MonotonicClock()
+        self.tenant_max_pending = int(tenant_max_pending)
+        self.tenant_max_active = int(tenant_max_active)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = 0
+        self._queued: List[RunTicket] = []
+        self._pending_by_tenant: Dict[str, int] = {}
+        self._active_by_tenant: Dict[str, int] = {}
+        self._closed = False
+
+    # -- producer side --------------------------------------------------
+
+    def push(self, ticket: RunTicket) -> None:
+        tm = get_telemetry()
+        tenant = ticket.handle.tenant
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("run queue is closed")
+            pending = self._pending_by_tenant.get(tenant, 0)
+            active = self._active_by_tenant.get(tenant, 0)
+            if (
+                self.tenant_max_pending > 0
+                and pending + active >= self.tenant_max_pending
+            ):
+                tm.counter("service.quota_rejections").inc()
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} at pending quota "
+                    f"({self.tenant_max_pending})"
+                )
+            self._seq += 1
+            ticket.seq = self._seq
+            ticket.submitted_at = self.clock.now()
+            ticket.handle.submitted_at = ticket.submitted_at
+            if ticket.budget is not None:
+                ticket.budget.start()  # queue wait burns the deadline
+            self._queued.append(ticket)
+            self._pending_by_tenant[tenant] = pending + 1
+            self._cond.notify_all()
+        tm.metrics.gauge("service.queue_depth").set(self.depth())
+
+    # -- consumer side --------------------------------------------------
+
+    def _resolve_dead(self, ticket: RunTicket) -> bool:
+        """Terminate a queued ticket whose envelope already closed.
+        Returns True when the ticket was consumed (dropped)."""
+        handle = ticket.handle
+        tm = get_telemetry()
+        if handle.cancel_token.cancelled:
+            handle.finished_at = self.clock.now()
+            handle._finish(
+                RunState.CANCELLED,
+                error=RunCancelled(
+                    handle.cancel_token.reason or "cancelled"
+                ),
+            )
+            tm.counter("service.cancelled_queued").inc()
+            tm.event(
+                "service_run_rejected",
+                run_id=handle.run_id,
+                tenant=handle.tenant,
+                reason="cancelled while queued",
+            )
+            return True
+        if ticket.budget is not None and ticket.budget.expired():
+            handle.finished_at = self.clock.now()
+            handle._finish(
+                RunState.REJECTED,
+                error=DeadlineExceeded(
+                    f"deadline of {ticket.budget.deadline_s}s expired "
+                    "while queued"
+                ),
+            )
+            tm.counter("service.deadline_rejections").inc()
+            tm.event(
+                "service_run_rejected",
+                run_id=handle.run_id,
+                tenant=handle.tenant,
+                reason="deadline expired while queued",
+            )
+            return True
+        return False
+
+    def _take_locked(self, max_priority: Optional[int]) -> Optional[RunTicket]:
+        """Best live ticket this worker may take, or None. Must hold
+        the lock. Scans in (priority, seq) order; resolves dead tickets
+        and skips tenants at their active quota."""
+        best: Optional[RunTicket] = None
+        dead: List[RunTicket] = []
+        for ticket in self._queued:
+            if self._resolve_dead(ticket):
+                dead.append(ticket)
+                continue
+            if max_priority is not None and (
+                ticket.handle.priority > max_priority
+            ):
+                continue
+            if self.tenant_max_active > 0 and (
+                self._active_by_tenant.get(ticket.handle.tenant, 0)
+                >= self.tenant_max_active
+            ):
+                continue
+            if best is None or ticket.sort_key < best.sort_key:
+                best = ticket
+        for ticket in dead:
+            self._remove_locked(ticket)
+        if best is not None:
+            self._queued.remove(best)
+            tenant = best.handle.tenant
+            self._pending_by_tenant[tenant] = max(
+                0, self._pending_by_tenant.get(tenant, 0) - 1
+            )
+            self._active_by_tenant[tenant] = (
+                self._active_by_tenant.get(tenant, 0) + 1
+            )
+        return best
+
+    def _remove_locked(self, ticket: RunTicket) -> None:
+        if ticket in self._queued:
+            self._queued.remove(ticket)
+        tenant = ticket.handle.tenant
+        self._pending_by_tenant[tenant] = max(
+            0, self._pending_by_tenant.get(tenant, 0) - 1
+        )
+
+    def pop(
+        self,
+        max_priority: Optional[int] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
+    ) -> Optional[RunTicket]:
+        """Block until a ticket this worker may run is available (or
+        ``should_stop()``/close). The wait polls at the clock's
+        ``queue_poll_s`` cadence so fake-clock tests resolve deadline
+        expiry promptly and shutdown is noticed without a wakeup."""
+        while True:
+            with self._cond:
+                ticket = self._take_locked(max_priority)
+                if ticket is not None:
+                    get_telemetry().metrics.gauge(
+                        "service.queue_depth"
+                    ).set(len(self._queued))
+                    return ticket
+                if self._closed or (
+                    should_stop is not None and should_stop()
+                ):
+                    return None
+                self._cond.wait(timeout=self.clock.queue_poll_s())
+
+    def task_done(self, ticket: RunTicket) -> None:
+        """Executor finished (or abandoned) a popped ticket: release
+        the tenant's active slot."""
+        with self._cond:
+            tenant = ticket.handle.tenant
+            self._active_by_tenant[tenant] = max(
+                0, self._active_by_tenant.get(tenant, 0) - 1
+            )
+            self._cond.notify_all()
+
+    # -- lifecycle / introspection --------------------------------------
+
+    def close(self) -> List[RunTicket]:
+        """Refuse new pushes and wake every waiting worker. Returns the
+        tickets still queued (the service terminates them on drain)."""
+        with self._cond:
+            self._closed = True
+            remaining = list(self._queued)
+            self._cond.notify_all()
+        return remaining
+
+    def drain_queued(self, reason: str) -> int:
+        """Cancel every still-queued ticket (shutdown semantics: running
+        work finishes and checkpoints; queued work terminates cleanly
+        with the shutdown reason). Returns how many were drained."""
+        with self._cond:
+            drained = list(self._queued)
+            self._queued.clear()
+            for ticket in drained:
+                self._remove_locked(ticket)  # fixes pending counters
+            self._cond.notify_all()
+        tm = get_telemetry()
+        for ticket in drained:
+            ticket.handle.finished_at = self.clock.now()
+            ticket.handle._finish(
+                RunState.CANCELLED, error=RunCancelled(reason)
+            )
+            tm.event(
+                "service_run_rejected",
+                run_id=ticket.handle.run_id,
+                tenant=ticket.handle.tenant,
+                reason=reason,
+            )
+        if drained:
+            tm.counter("service.drained_queued").inc(len(drained))
+        tm.metrics.gauge("service.queue_depth").set(self.depth())
+        return len(drained)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queued)
+
+    def wait_event(self, timeout: float) -> None:
+        """Block until queue state MAY have changed (bounded by
+        ``timeout`` seconds) — the building block for idle waits."""
+        with self._cond:
+            self._cond.wait(timeout=timeout)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "depth": len(self._queued),
+                "pending_by_tenant": dict(self._pending_by_tenant),
+                "active_by_tenant": dict(self._active_by_tenant),
+                "closed": self._closed,
+            }

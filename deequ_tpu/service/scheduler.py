@@ -1,0 +1,161 @@
+"""Worker pool draining the run queue under priority discipline.
+
+The scheduler owns N executor threads. The first
+``interactive_reserve`` of them ONLY ever take INTERACTIVE-class
+tickets — that reserve is the anti-starvation mechanism: however long
+a BATCH run occupies the general workers, a reserved worker is always
+free for the next high-priority short run, so an interactive run's
+queue wait is bounded by interactive traffic alone, never by batch
+residency (asserted on fake clocks in tests/test_service.py; the
+acceptance scenario in examples/verification_service.py).
+
+The ``execute`` callable is injected: the real service passes a
+closure that leases the shared dataset and drives
+``VerificationSuite.do_verification_run`` through the admission layer;
+fake-clock tests pass stubs that advance a ``ManualClock`` instead of
+doing work. The scheduler itself therefore never needs real time — its
+only blocking wait is ``RunQueue.pop``, which polls at the injected
+clock's cadence.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from deequ_tpu.engine.deadline import MonotonicClock
+from deequ_tpu.service.queue import Priority, RunQueue, RunState, RunTicket
+from deequ_tpu.telemetry import get_telemetry
+
+
+class Scheduler:
+    """N worker threads popping the queue; ``interactive_reserve`` of
+    them restricted to the INTERACTIVE class."""
+
+    def __init__(
+        self,
+        queue: RunQueue,
+        execute: Callable[[RunTicket], Any],
+        workers: int = 2,
+        interactive_reserve: int = 1,
+        clock: Any = None,
+    ):
+        self.queue = queue
+        self.execute = execute
+        self.workers = max(1, int(workers))
+        # at least one general worker must remain or BATCH/STANDARD
+        # work could never run at all
+        self.interactive_reserve = min(
+            max(0, int(interactive_reserve)), self.workers - 1
+        )
+        self.clock = clock or MonotonicClock()
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for i in range(self.workers):
+            reserved = i < self.interactive_reserve
+            thread = threading.Thread(
+                target=self._worker_loop,
+                args=(Priority.INTERACTIVE if reserved else None,),
+                daemon=True,
+                name=(
+                    f"deequ-tpu-service-{'reserve' if reserved else 'exec'}"
+                    f"-{i}"
+                ),
+            )
+            self._threads.append(thread)
+            thread.start()
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop taking new work and join the workers. Running tickets
+        finish (the service cancels them first on a hard stop)."""
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+    @property
+    def running(self) -> bool:
+        return any(t.is_alive() for t in self._threads)
+
+    # -- the worker loop ------------------------------------------------
+
+    def _worker_loop(self, max_priority: Optional[int]) -> None:
+        tm = get_telemetry()
+        while not self._stop.is_set():
+            ticket = self.queue.pop(
+                max_priority=max_priority,
+                should_stop=self._stop.is_set,
+            )
+            if ticket is None:
+                return  # queue closed or scheduler stopping
+            handle = ticket.handle
+            handle.started_at = self.clock.now()
+            wait_s = max(0.0, handle.started_at - ticket.submitted_at)
+            tm.metrics.histogram(
+                "service.queue_wait_s",
+                buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0),
+            ).observe(wait_s)
+            handle._mark_running()
+            tm.event(
+                "service_run_started",
+                run_id=handle.run_id,
+                tenant=handle.tenant,
+                priority=Priority.name(handle.priority),
+                queue_wait_s=round(wait_s, 6),
+            )
+            try:
+                result = self.execute(ticket)
+            except BaseException as exc:  # noqa: BLE001 — the handle is
+                # the error channel; a worker must survive any run
+                handle.finished_at = self.clock.now()
+                handle._finish(RunState.FAILED, error=exc)
+                tm.counter("service.failed").inc()
+                tm.event(
+                    "service_run_finished",
+                    run_id=handle.run_id,
+                    tenant=handle.tenant,
+                    priority=Priority.name(handle.priority),
+                    status="failed",
+                    error=repr(exc),
+                )
+            else:
+                handle.finished_at = self.clock.now()
+                interruption = getattr(result, "interruption", None)
+                cancelled = (
+                    interruption is not None
+                    and getattr(interruption, "kind", "") != "deadline"
+                )
+                handle._finish(
+                    RunState.CANCELLED if cancelled else RunState.DONE,
+                    result=result,
+                )
+                tm.counter("service.completed").inc()
+                tm.counter(f"service.tenant.{handle.tenant}.runs").inc()
+                tm.event(
+                    "service_run_finished",
+                    run_id=handle.run_id,
+                    tenant=handle.tenant,
+                    priority=Priority.name(handle.priority),
+                    status=(
+                        "cancelled" if cancelled else str(
+                            getattr(
+                                getattr(result, "status", None),
+                                "value",
+                                "done",
+                            )
+                        )
+                    ),
+                    wall_s=round(
+                        handle.finished_at - handle.started_at, 6
+                    ),
+                    interrupted=interruption is not None,
+                )
+            finally:
+                self.queue.task_done(ticket)

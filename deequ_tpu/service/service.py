@@ -1,0 +1,357 @@
+"""VerificationService: the always-on, multi-tenant facade.
+
+Composition (docs/SERVICE.md has the architecture picture):
+
+- ``submit()`` validates quotas, wraps the suite in a ``RunTicket``
+  (deadline budget pinned at submit — queue wait burns it, matching
+  the admission controller), and returns a ``RunHandle``;
+- the ``Scheduler``'s workers pop by priority and drive the run
+  through ``VerificationSuite.do_verification_run`` — i.e. through
+  the runner's admission layer (``max_concurrent_runs`` +
+  ``memory_watermark_bytes`` still gate device admission underneath;
+  the service NEVER calls ``engine.run_scan`` directly, enforced by
+  tools/telemetry_lint.py);
+- the shared ``DatasetCache`` hands every run of the same table the
+  same resident handle (one ``device_put`` for N tenants), pinned for
+  the run's duration;
+- ``warmup()`` precompiles the submitted suites' fused plans at
+  startup via the ``tools/warmup.py`` machinery and records the warmed
+  plan tokens in the ``PlanCache`` ledger, so steady state shows zero
+  recompiles.
+
+Shutdown: ``stop(drain=True)`` finishes queued work; ``drain(reason)``
+(also wired to SIGTERM when ``start(install_sigterm=True)``) cancels
+QUEUED runs cleanly while RUNNING runs finish under the engine's
+graceful-shutdown supervision — checkpointed, partial metrics, the
+same contract as a direct bounded run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from deequ_tpu.engine.deadline import (
+    MonotonicClock,
+    RunBudget,
+    shutdown_token,
+)
+from deequ_tpu.service.caches import DatasetCache, PlanCache
+from deequ_tpu.service.queue import (
+    Priority,
+    RunHandle,
+    RunQueue,
+    RunTicket,
+)
+from deequ_tpu.service.scheduler import Scheduler
+from deequ_tpu.telemetry import get_telemetry
+
+
+@dataclass
+class RunRequest:
+    """One suite submission. ``dataset_key`` + ``dataset_factory``
+    address the shared dataset cache (same key -> same resident
+    handle); pass a ``dataset`` directly to bypass sharing (it becomes
+    a single-use factory keyed by object id)."""
+
+    tenant: str
+    checks: Sequence[Any]
+    dataset_key: Optional[str] = None
+    dataset_factory: Optional[Callable[[], Any]] = None
+    dataset: Optional[Any] = None
+    required_analyzers: Sequence[Any] = ()
+    priority: int = Priority.STANDARD
+    deadline_s: Optional[float] = None
+    metrics_repository: Any = None
+    result_key: Any = None
+
+    def __post_init__(self):
+        if self.dataset is not None and self.dataset_factory is None:
+            ds = self.dataset
+            self.dataset_factory = lambda: ds
+            if self.dataset_key is None:
+                self.dataset_key = f"dataset-{id(ds):x}"
+        if self.dataset_key is None or self.dataset_factory is None:
+            raise ValueError(
+                "RunRequest needs dataset_key + dataset_factory "
+                "(or a dataset)"
+            )
+
+
+class VerificationService:
+    """Long-lived multi-tenant verification daemon. All knobs default
+    from ``config.options()`` (service_* options); ``clock`` is
+    injectable for fake-time tests and drives every scheduling
+    decision."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        interactive_reserve: Optional[int] = None,
+        clock: Any = None,
+        dataset_watermark_bytes: Optional[int] = None,
+        tenant_max_pending: Optional[int] = None,
+        tenant_max_active: Optional[int] = None,
+        execute: Optional[Callable[[RunTicket], Any]] = None,
+    ):
+        from deequ_tpu import config
+
+        opts = config.options()
+        self.clock = clock or MonotonicClock()
+        watermark = (
+            dataset_watermark_bytes
+            if dataset_watermark_bytes is not None
+            else (
+                opts.service_dataset_watermark_bytes
+                or opts.device_cache_bytes
+            )
+        )
+        self.datasets = DatasetCache(watermark_bytes=watermark)
+        self.plans = PlanCache()
+        self.queue = RunQueue(
+            clock=self.clock,
+            tenant_max_pending=(
+                tenant_max_pending
+                if tenant_max_pending is not None
+                else opts.service_tenant_max_pending
+            ),
+            tenant_max_active=(
+                tenant_max_active
+                if tenant_max_active is not None
+                else opts.service_tenant_max_active
+            ),
+        )
+        self.scheduler = Scheduler(
+            self.queue,
+            execute if execute is not None else self._execute,
+            workers=(
+                workers if workers is not None else opts.service_workers
+            ),
+            interactive_reserve=(
+                interactive_reserve
+                if interactive_reserve is not None
+                else opts.service_interactive_reserve
+            ),
+            clock=self.clock,
+        )
+        self._run_ids = itertools.count(1)
+        self._handles: Dict[str, RunHandle] = {}
+        self._handles_lock = threading.Lock()
+        self._uninstall_sigterm: Optional[Callable[[], None]] = None
+        self._sigterm_watcher: Optional[threading.Thread] = None
+        self._watcher_stop = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, install_sigterm: bool = False) -> "VerificationService":
+        if install_sigterm:
+            from deequ_tpu.engine.deadline import install_graceful_shutdown
+
+            self._uninstall_sigterm = install_graceful_shutdown()
+            self._watcher_stop.clear()
+            self._sigterm_watcher = threading.Thread(
+                target=self._watch_shutdown,
+                daemon=True,
+                name="deequ-tpu-service-shutdown-watch",
+            )
+            self._sigterm_watcher.start()
+        self.scheduler.start()
+        get_telemetry().event(
+            "service_started",
+            workers=self.scheduler.workers,
+            interactive_reserve=self.scheduler.interactive_reserve,
+        )
+        return self
+
+    def _watch_shutdown(self) -> None:
+        token = shutdown_token()
+        while not self._watcher_stop.is_set():
+            # Event.wait on the token — event-driven, not a time poll;
+            # the short timeout only lets a stopped service reclaim the
+            # watcher thread
+            if token.wait(timeout=0.1):
+                self.drain(token.reason or "shutdown requested")
+                return
+
+    def stop(
+        self, drain: bool = True, timeout: Optional[float] = 30.0
+    ) -> None:
+        """Shut the service down. ``drain=True`` finishes everything
+        already queued first; ``drain=False`` cancels queued runs
+        (running ones still finish — workers are cooperative, not
+        preemptive)."""
+        if drain:
+            self.wait_idle(timeout=timeout)
+        self.queue.close()
+        if not drain:
+            self.queue.drain_queued("service stopping")
+        self._watcher_stop.set()
+        self.scheduler.stop(timeout=timeout)
+        if self._uninstall_sigterm is not None:
+            self._uninstall_sigterm()
+            self._uninstall_sigterm = None
+        get_telemetry().event("service_stopped", drained=drain)
+
+    def drain(self, reason: str = "shutdown requested") -> int:
+        """SIGTERM semantics: refuse new work, cancel QUEUED runs with
+        ``reason``, let RUNNING runs finish under the engine's
+        supervision (checkpoint + partial metrics). Returns the number
+        of queued runs drained."""
+        self.queue.close()
+        drained = self.queue.drain_queued(reason)
+        get_telemetry().event(
+            "service_drained", reason=reason, drained=drained
+        )
+        return drained
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until nothing is queued or running (best-effort;
+        returns False on timeout). Poll cadence comes from the clock so
+        fake-time tests spin fast."""
+        deadline = (
+            None if timeout is None else self.clock.now() + timeout
+        )
+        while True:
+            snap = self.queue.snapshot()
+            active = sum(snap["active_by_tenant"].values())
+            if snap["depth"] == 0 and active == 0:
+                return True
+            if deadline is not None and self.clock.now() > deadline:
+                return False
+            self.queue.wait_event(self.clock.queue_poll_s())
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, request: RunRequest) -> RunHandle:
+        """Queue one suite run; returns immediately with the handle.
+        Raises ``QuotaExceeded`` when the tenant is over its pending
+        quota. The deadline budget starts NOW — time spent queued
+        counts against it."""
+        run_id = f"run-{next(self._run_ids)}"
+        handle = RunHandle(run_id, request.tenant, request.priority)
+        budget = None
+        if request.deadline_s is not None:
+            budget = RunBudget(
+                deadline_s=float(request.deadline_s), clock=self.clock
+            )
+        ticket = RunTicket(
+            seq=0,  # assigned by the queue
+            handle=handle,
+            payload=request,
+            budget=budget,
+            dataset_key=request.dataset_key,
+        )
+        tm = get_telemetry()
+        self.queue.push(ticket)  # raises QuotaExceeded pre-registration
+        with self._handles_lock:
+            self._handles[run_id] = handle
+        tm.counter("service.submitted").inc()
+        tm.counter(f"service.tenant.{request.tenant}.submitted").inc()
+        tm.event(
+            "service_run_submitted",
+            run_id=run_id,
+            tenant=request.tenant,
+            priority=Priority.name(request.priority),
+            dataset_key=request.dataset_key,
+            deadline_s=request.deadline_s,
+        )
+        return handle
+
+    def handle(self, run_id: str) -> Optional[RunHandle]:
+        with self._handles_lock:
+            return self._handles.get(run_id)
+
+    # -- warmup ---------------------------------------------------------
+
+    def warmup(
+        self,
+        schema: Dict[str, str],
+        suite: bool = True,
+        nullable=(False, True),
+        **kwargs,
+    ) -> List[str]:
+        """Precompile the fused plans production suites will need
+        (tools/warmup.py machinery) and record the warmed plan tokens.
+        Returns the tokens; after this, matching submissions execute
+        with zero recompiles (the acceptance telemetry in
+        examples/verification_service.py)."""
+        warm_plans = _load_warm_plans()
+        report = warm_plans(
+            schema, suite=suite, nullable=nullable, **kwargs
+        )
+        self.plans.note_warmed(report.get("tokens", []))
+        return list(report.get("tokens", []))
+
+    # -- the real executor ----------------------------------------------
+
+    def _execute(self, ticket: RunTicket):
+        from deequ_tpu.verification.suite import VerificationSuite
+
+        request: RunRequest = ticket.payload
+        dataset, hit = self.datasets.lease(
+            request.dataset_key, request.dataset_factory
+        )
+        get_telemetry().event(
+            "service_dataset_leased",
+            run_id=ticket.handle.run_id,
+            dataset_key=request.dataset_key,
+            cache_hit=hit,
+        )
+        try:
+            result = VerificationSuite.do_verification_run(
+                dataset,
+                request.checks,
+                required_analyzers=request.required_analyzers,
+                metrics_repository=request.metrics_repository,
+                save_or_append_results_with_key=request.result_key,
+                deadline=ticket.budget,
+                cancel=ticket.handle.cancel_token,
+            )
+        finally:
+            self.datasets.release(request.dataset_key)
+        # per-run plan-cache accounting from the run's own telemetry
+        # summary (counter deltas) — recompiles-after-warmup is THE
+        # steady-state health signal
+        self.plans.record_run(getattr(result, "telemetry", None))
+        return result
+
+    # -- introspection --------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "queue": self.queue.snapshot(),
+            "datasets": self.datasets.snapshot(),
+            "plans": self.plans.snapshot(),
+        }
+
+
+def _load_warm_plans():
+    """Resolve ``tools.warmup.warm_plans`` without requiring ``tools``
+    to be an installed package: try the repo-layout import first, then
+    load the module straight off the file next to this package."""
+    try:
+        from tools.warmup import warm_plans  # type: ignore
+
+        return warm_plans
+    except ImportError:
+        pass
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "tools",
+        "warmup.py",
+    )
+    spec = importlib.util.spec_from_file_location(
+        "deequ_tpu_tools_warmup", path
+    )
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load warmup module from {path}")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module.warm_plans

@@ -545,3 +545,53 @@ class TestTools:
         assert violations == [
             ("deequ_tpu/engine/rogue.py", 3, "perf_counter")
         ]
+
+    def test_lint_service_bans_direct_time(self, tmp_path):
+        """PR 7 rule: service modules run on injected clocks only —
+        time.time / time.sleep are violations there (and only there:
+        the same tokens in a non-service module stay legal)."""
+        from tools.telemetry_lint import find_violations
+
+        bad = tmp_path / "deequ_tpu" / "service"
+        bad.mkdir(parents=True)
+        (bad / "rogue.py").write_text(
+            "import time\n"
+            "# time.time in a comment is fine\n"
+            "now = time.time()\n"
+            "time.sleep(1)\n"
+        )
+        elsewhere = tmp_path / "deequ_tpu" / "repository"
+        elsewhere.mkdir(parents=True)
+        (elsewhere / "fine.py").write_text("import time\nt = time.time()\n")
+        violations = find_violations(str(tmp_path))
+        assert ("deequ_tpu/service/rogue.py", 3, "time.time") in violations
+        assert ("deequ_tpu/service/rogue.py", 4, "sleep") in violations
+        assert all("fine.py" not in rel for rel, _l, _t in violations)
+
+    def test_lint_service_bans_admission_bypass(self, tmp_path):
+        """PR 7 rule: the service must reach the engine through the
+        runner's admission layer — a direct run_scan reference in a
+        service module flags."""
+        from tools.telemetry_lint import find_violations
+
+        bad = tmp_path / "deequ_tpu" / "service"
+        bad.mkdir(parents=True)
+        (bad / "rogue.py").write_text(
+            "def go(engine, ds, pairs):\n"
+            "    return engine.run_scan(ds, pairs)\n"
+        )
+        violations = find_violations(str(tmp_path))
+        assert ("deequ_tpu/service/rogue.py", 2, "run_scan") in violations
+
+    def test_lint_real_service_package_is_clean(self):
+        """The shipped service package obeys its own rules."""
+        from tools.telemetry_lint import find_violations
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        service = [
+            v for v in find_violations(root)
+            if v[0].startswith("deequ_tpu/service/")
+        ]
+        assert service == []

@@ -161,11 +161,155 @@ def render_run(summary: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(
+        len(sorted_vals) - 1,
+        max(0, int(round(q * (len(sorted_vals) - 1)))),
+    )
+    return sorted_vals[idx]
+
+
+def render_service(records: List[Dict[str, Any]]) -> str:
+    """The ``service:`` section: per-tenant run counts, queue-wait
+    percentiles, plan-cache hits vs recompiles, and dataset-cache
+    hits/evictions — everything an operator needs to answer "is the
+    warm path actually warm" from one JSONL artifact. Empty string when
+    the artifact has no service events."""
+    events = [r for r in records if r.get("type") == "event"]
+    service_events = [
+        e for e in events
+        if str(e.get("event", "")).startswith("service_")
+    ]
+    if not service_events:
+        return ""
+
+    lines = ["service:"]
+
+    # per-tenant run counts, split by outcome
+    by_tenant: Dict[str, Dict[str, int]] = {}
+    for e in service_events:
+        if e.get("event") != "service_run_finished":
+            continue
+        tenant = str(e.get("tenant", "?"))
+        status = str(e.get("status", "?"))
+        by_tenant.setdefault(tenant, {})
+        by_tenant[tenant][status] = by_tenant[tenant].get(status, 0) + 1
+    rejected = [
+        e for e in service_events
+        if e.get("event") == "service_run_rejected"
+    ]
+    for e in rejected:
+        tenant = str(e.get("tenant", "?"))
+        by_tenant.setdefault(tenant, {})
+        by_tenant[tenant]["rejected"] = (
+            by_tenant[tenant].get("rejected", 0) + 1
+        )
+    if by_tenant:
+        lines.append("  runs by tenant:")
+        for tenant in sorted(by_tenant):
+            outcomes = by_tenant[tenant]
+            total = sum(outcomes.values())
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(outcomes.items())
+            )
+            lines.append(f"    {tenant:<16} {total:<4} ({detail})")
+
+    # queue-wait percentiles from the started events
+    waits = sorted(
+        float(e.get("queue_wait_s", 0.0))
+        for e in service_events
+        if e.get("event") == "service_run_started"
+    )
+    if waits:
+        lines.append(
+            f"  queue wait ({len(waits)} run(s)):"
+            f" p50={_percentile(waits, 0.50):.3f}s"
+            f" p90={_percentile(waits, 0.90):.3f}s"
+            f" p99={_percentile(waits, 0.99):.3f}s"
+            f" max={waits[-1]:.3f}s"
+        )
+
+    # plan cache: warmed tokens vs steady-state hits/recompiles. The
+    # authoritative hit/miss deltas live in the run summaries' counter
+    # blocks (engine.plan_cache.*); warmup passes also produce run
+    # summaries, so split on the warmed event's position in the file.
+    warmed = [
+        e for e in service_events
+        if e.get("event") == "service_plans_warmed"
+    ]
+    plan_hits = 0.0
+    plan_misses = 0.0
+    for r in load_runs(records):
+        counters = r.get("counters", {})
+        plan_hits += counters.get("engine.plan_cache.hits", 0)
+        plan_misses += counters.get("engine.plan_cache.misses", 0)
+    lines.append(
+        f"  plan cache: hits={int(plan_hits)}"
+        f" compiles={int(plan_misses)}"
+        + (
+            f" (warmed"
+            f" {sum(len(e.get('tokens', [])) for e in warmed)}"
+            f" plan(s) at startup)"
+            if warmed
+            else ""
+        )
+    )
+
+    # dataset cache: placements (misses) vs shared leases (hits) vs
+    # watermark evictions
+    leases = [
+        e for e in service_events
+        if e.get("event") == "service_dataset_leased"
+    ]
+    if leases:
+        hits = sum(1 for e in leases if e.get("cache_hit"))
+        evictions = sum(
+            1 for e in service_events
+            if e.get("event") == "service_dataset_evicted"
+        )
+        lines.append(
+            f"  dataset cache: hits={hits}"
+            f" placements={len(leases) - hits}"
+            f" evictions={evictions}"
+        )
+        keys = sorted(
+            {str(e.get("dataset_key", "?")) for e in leases}
+        )
+        lines.append(f"    keys: {', '.join(keys)}")
+
+    # drains / rejections worth an operator's attention
+    drains = [
+        e for e in service_events
+        if e.get("event") == "service_drained"
+    ]
+    for e in drains:
+        lines.append(
+            f"  drained {e.get('drained', 0)} queued run(s):"
+            f" {e.get('reason', '?')}"
+        )
+    deadline_rejects = sum(
+        1 for e in rejected
+        if "deadline" in str(e.get("reason", ""))
+    )
+    if deadline_rejects:
+        lines.append(
+            f"  deadline-expired while queued: {deadline_rejects}"
+        )
+    return "\n".join(lines)
+
+
 def render(
     records: List[Dict[str, Any]],
     run_id: Optional[int] = None,
     counters_only: bool = False,
+    service_only: bool = False,
 ) -> str:
+    if service_only:
+        section = render_service(records)
+        return section or "no service events in artifact"
     runs = load_runs(records)
     if run_id is not None:
         runs = [r for r in runs if r.get("run_id") == run_id]
@@ -190,7 +334,12 @@ def render(
             f"{n_events} events) — was a run context "
             "(telemetry.run(...)) active?"
         )
-    return "\n\n".join(render_run(r) for r in runs)
+    body = "\n\n".join(render_run(r) for r in runs)
+    if run_id is None:
+        section = render_service(records)
+        if section:
+            body = body + "\n\n" + section
+    return body
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -205,13 +354,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--counters", action="store_true",
         help="print only counter totals across runs",
     )
+    parser.add_argument(
+        "--service", action="store_true",
+        help="print only the multi-tenant service section",
+    )
     args = parser.parse_args(argv)
     try:
         records = read_jsonl(args.path)
     except OSError as exc:
         print(f"cannot read {args.path}: {exc}", file=sys.stderr)
         return 2
-    print(render(records, run_id=args.run, counters_only=args.counters))
+    print(render(
+        records,
+        run_id=args.run,
+        counters_only=args.counters,
+        service_only=args.service,
+    ))
     return 0
 
 

@@ -23,7 +23,15 @@ exact regression class the 2-syncs-per-profile pin exists to prevent
 tokens in engine modules outside pack.py are flagged unless the line
 carries an inline ``# sync-ok: <reason>`` waiver documenting why the
 call is host-side or a deliberate, clock-attributed sync (checkpoint
-drain, mesh epilogue). Run from the test suite
+drain, mesh epilogue).
+
+Service discipline (PR 7): modules under ``deequ_tpu/service/`` may
+not read or burn wall time themselves (``time.time``/``time.sleep``/
+``monotonic``/``perf_counter``) — every scheduling decision rides the
+injectable clocks from ``engine/deadline.py`` so the whole scheduler
+is assertable on fake time — and may not bypass the runner's admission
+layer by referencing the engine scan entry points (``run_scan``,
+``prepare_scan``, ``execute_plan``). Run from the test suite
 (tests/test_telemetry.py) and by hand:
 
     python -m tools.telemetry_lint [repo_root]
@@ -49,6 +57,7 @@ HOT_PATH_DIRS = (
     "deequ_tpu/checks",
     "deequ_tpu/io",
     "deequ_tpu/utils",
+    "deequ_tpu/service",
 )
 
 # NAME tokens that mean "module does its own timing/tracing"
@@ -79,6 +88,31 @@ SYNC_HOT_PREFIX = "deequ_tpu/engine/"
 SYNC_EXEMPT_FILES = frozenset({"deequ_tpu/engine/pack.py"})
 SYNC_WAIVER_MARKER = "sync-ok:"
 
+# the service layer (deequ_tpu/service/, docs/SERVICE.md) runs on
+# INJECTED clocks only — the engine/deadline.py discipline that makes
+# every scheduling behavior assertable on fake time — and must enter
+# execution through the runner's admission layer, never the engine
+# directly. Two rule families:
+# - direct time: bare ``sleep``/``monotonic``/``perf_counter`` NAME
+#   tokens, plus the ``time.<attr>`` attribute chain (``time.time`` is
+#   caught by sequence, not by banning the ubiquitous NAME "time")
+# - admission bypass: any reference to the engine's scan entry points
+SERVICE_PREFIX = "deequ_tpu/service/"
+SERVICE_FORBIDDEN_NAMES = frozenset(
+    {
+        "sleep",
+        "monotonic",
+        "run_scan",
+        "prepare_scan",
+        "execute_plan",
+        "_run_scan_resident",
+        "_run_scan_streaming",
+    }
+)
+SERVICE_TIME_ATTRS = frozenset(
+    {"time", "sleep", "monotonic", "perf_counter"}
+)
+
 
 def find_violations(root: str) -> List[Tuple[str, int, str]]:
     """(relpath, line, token) for every forbidden NAME token in a
@@ -106,6 +140,7 @@ def find_violations(root: str) -> List[Tuple[str, int, str]]:
                 sync_checked = rel.startswith(
                     SYNC_HOT_PREFIX
                 ) and rel not in SYNC_EXEMPT_FILES
+                service_checked = rel.startswith(SERVICE_PREFIX)
                 with open(path, "rb") as fh:
                     source = fh.read()
                 try:
@@ -153,7 +188,42 @@ def find_violations(root: str) -> List[Tuple[str, int, str]]:
                         violations.append(
                             (rel, tok.start[0], "<oom marker string>")
                         )
+                if service_checked:
+                    violations.extend(
+                        (rel, line, name)
+                        for line, name in _service_violations(tokens)
+                    )
     return violations
+
+
+def _service_violations(tokens) -> List[Tuple[int, str]]:
+    """Service-layer rules on one module's token stream: banned NAME
+    tokens (own sleeps/clocks, engine scan entry points) plus the
+    ``time.<attr>`` attribute-chain check for ``time.time`` (sequence
+    over significant tokens, so comments/docstrings never flag)."""
+    out: List[Tuple[int, str]] = []
+    significant = [
+        tok
+        for tok in tokens
+        if tok.type
+        in (tokenize.NAME, tokenize.OP, tokenize.NUMBER, tokenize.STRING)
+    ]
+    for i, tok in enumerate(significant):
+        if tok.type != tokenize.NAME:
+            continue
+        if tok.string in SERVICE_FORBIDDEN_NAMES:
+            out.append((tok.start[0], tok.string))
+        elif (
+            tok.string == "time"
+            and i + 2 < len(significant)
+            and significant[i + 1].string == "."
+            and significant[i + 2].type == tokenize.NAME
+            and significant[i + 2].string in SERVICE_TIME_ATTRS
+        ):
+            out.append(
+                (tok.start[0], f"time.{significant[i + 2].string}")
+            )
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -135,16 +135,29 @@ def synthetic_dataset(schema, rows: int, nullable: bool, wide_ints: bool,
 
 
 def warm_once(schema, rows, nullable, wide_ints, suite: bool,
-              high_card_strings: bool = False) -> float:
-    from deequ_tpu.profiles.profiler import ColumnProfiler
-
+              high_card_strings: bool = False, checks=None,
+              profile: bool = True) -> float:
+    """One warm pass: the ColumnProfiler plan (unless ``profile=False``)
+    plus a VerificationSuite plan — either the EXACT production
+    ``checks`` (the service warms the suites it will actually serve) or
+    a synthesized schema-shaped check when ``suite=True``."""
     ds = synthetic_dataset(
         schema, rows, nullable, wide_ints,
         high_card_strings=high_card_strings,
     )
     t0 = time.time()
-    ColumnProfiler.profile(ds)
-    if suite:
+    if profile:
+        from deequ_tpu.profiles.profiler import ColumnProfiler
+
+        ColumnProfiler.profile(ds)
+    if checks is not None:
+        from deequ_tpu import VerificationSuite
+
+        # compiles key on structure/shapes/dtypes, never values — a
+        # synthetic dataset with the production schema warms the
+        # production suite's plan exactly
+        VerificationSuite().on_data(ds).add_checks(list(checks)).run()
+    elif suite:
         from deequ_tpu import Check, CheckLevel, VerificationSuite
 
         check = Check(CheckLevel.ERROR, "warmup")
@@ -154,10 +167,103 @@ def warm_once(schema, rows, nullable, wide_ints, suite: bool,
                 check = check.is_non_negative(name)
             if kind in ("int32", "int64", "string"):
                 check = check.is_unique(name)
-        # compiles key on structure/shapes/dtypes, never values —
         # the profiler's dataset warms the suite plan equally well
         VerificationSuite().on_data(ds).add_check(check).run()
     return time.time() - t0
+
+
+def default_engine_variants(schema) -> list:
+    """Engine-option variants that change the compiled program for
+    this schema on THIS host (each is a distinct plan-cache
+    fingerprint; see engine/scan.py ``_plan_cache_key``). The default
+    pass warms (xla scatter, widening on); extra passes only run when
+    they would actually compile something different."""
+    from deequ_tpu import config
+    from deequ_tpu.sketches import pallas_scatter
+
+    variants = [{}]
+    if any(k in ("int32", "int64") for k in schema.values()):
+        # dedup-gate branch: widening off is the scatter-only pooled
+        # HLL unit — warm it so flipping the escape hatch in
+        # production is free
+        variants.append({"hll_dedup_widening": False})
+    with config.configure(pallas_scatter=True):
+        if pallas_scatter.impl_token() == "pallas":
+            variants.append({"pallas_scatter": True})
+    return variants
+
+
+def warm_plans(
+    schema,
+    suite: bool = False,
+    batch_size=None,
+    nullable=(False, True),
+    wide_ints=None,
+    high_card_strings=(False,),
+    engine_variants=None,
+    checks=None,
+    profile: bool = True,
+    log=None,
+) -> dict:
+    """Warm every fused-plan variant for ``schema`` and REPORT what got
+    warmed — the reusable core behind both the CLI and the
+    verification service's startup warmup (deequ_tpu/service).
+
+    Returns ``{"tokens": [...], "already_warm": int, "passes": int,
+    "total_s": float}`` where ``tokens`` are the structural plan-cache
+    tokens (engine/scan.py ``plan_cache_snapshot``) ADDED by this call
+    — the currency the service's PlanCache ledger tracks."""
+    from deequ_tpu import config
+    from deequ_tpu.engine.scan import DEFAULT_MAX_BATCH, plan_cache_snapshot
+
+    batch = (
+        batch_size or config.options().batch_size or DEFAULT_MAX_BATCH
+    )
+    # ONE batch of warm rows: compiles are shape-keyed, so more adds
+    # nothing; engines resolve batch_size = min(rows, default), so the
+    # warm row count must equal the production batch size exactly
+    rows = batch
+    has_int64 = any(k == "int64" for k in schema.values())
+    has_string = any(k == "string" for k in schema.values())
+    if wide_ints is None:
+        wide_ints = (False, True) if has_int64 else (False,)
+    if not has_string:
+        high_card_strings = (False,)
+    if engine_variants is None:
+        engine_variants = default_engine_variants(schema)
+
+    before = set(plan_cache_snapshot())
+    total = 0.0
+    passes = 0
+    for variant in engine_variants:
+        tag = (
+            " ".join(f"{k}={v}" for k, v in variant.items()) or "default"
+        )
+        with config.configure(batch_size=batch, **variant):
+            for null in nullable:
+                for wide in wide_ints:
+                    for high_card in high_card_strings:
+                        t = warm_once(
+                            schema, rows, null, wide, suite,
+                            high_card_strings=high_card,
+                            checks=checks, profile=profile,
+                        )
+                        total += t
+                        passes += 1
+                        if log is not None:
+                            log(
+                                f"  warmed [{tag}] nullable={null} "
+                                f"wide_ints={wide} "
+                                f"high_card_strings={high_card}: {t:.1f}s"
+                            )
+    after = plan_cache_snapshot()
+    tokens = [t for t in after if t not in before]
+    return {
+        "tokens": tokens,
+        "already_warm": len(before & set(after)),
+        "passes": passes,
+        "total_s": total,
+    }
 
 
 def main() -> int:
@@ -205,13 +311,7 @@ def main() -> int:
     print(f"schema: {schema}")
 
     from deequ_tpu import config
-    from deequ_tpu.engine.scan import DEFAULT_MAX_BATCH
 
-    batch = args.batch_size or config.options().batch_size or DEFAULT_MAX_BATCH
-    # ONE batch of warm rows: compiles are shape-keyed, so more adds
-    # nothing; engines resolve batch_size = min(rows, default), so the
-    # warm row count must equal the production batch size exactly
-    rows = batch
     nullables = {
         "none": (False,), "all": (True,), "both": (False, True)
     }[args.nullable]
@@ -222,47 +322,21 @@ def main() -> int:
         "low": (False,), "high": (True,), "both": (False, True)
     }[args.string_cardinality]
     has_int64 = any(k == "int64" for k in schema.values())
-    has_int = any(k in ("int32", "int64") for k in schema.values())
-    has_string = any(k == "string" for k in schema.values())
 
-    # engine-option variants that change the compiled program (each is
-    # a distinct plan-cache fingerprint; see engine/scan.py
-    # _plan_cache_key). The default pass warms
-    # (xla scatter, widening on); extra passes only run when they
-    # would actually compile something different on THIS host/schema.
-    from deequ_tpu.sketches import pallas_scatter
-
-    engine_variants = [{}]
-    if has_int:
-        # dedup-gate branch: widening off is the scatter-only pooled
-        # HLL unit — warm it so flipping the escape hatch in
-        # production is free
-        engine_variants.append({"hll_dedup_widening": False})
-    with config.configure(pallas_scatter=True):
-        if pallas_scatter.impl_token() == "pallas":
-            engine_variants.append({"pallas_scatter": True})
-
-    total = 0.0
-    for variant in engine_variants:
-        tag = (
-            " ".join(f"{k}={v}" for k, v in variant.items()) or "default"
-        )
-        with config.configure(batch_size=batch, **variant):
-            for nullable in nullables:
-                for wide in widths if has_int64 else (False,):
-                    for high_card in cards if has_string else (False,):
-                        t = warm_once(
-                            schema, rows, nullable, wide, args.suite,
-                            high_card_strings=high_card,
-                        )
-                        total += t
-                        print(
-                            f"  warmed [{tag}] nullable={nullable} "
-                            f"wide_ints={wide} "
-                            f"high_card_strings={high_card}: {t:.1f}s"
-                        )
+    report = warm_plans(
+        schema,
+        suite=args.suite,
+        batch_size=args.batch_size,
+        nullable=nullables,
+        wide_ints=widths if has_int64 else (False,),
+        high_card_strings=cards,
+        log=print,
+    )
+    tokens = ", ".join(report["tokens"]) or "(all already resident)"
+    print(f"warmed plan tokens: {tokens}")
     print(
-        f"done in {total:.1f}s — plans persisted to "
+        f"done in {report['total_s']:.1f}s ({report['passes']} passes) "
+        f"— plans persisted to "
         f"{config.options().compilation_cache_dir}; the first "
         "production run now deserializes instead of compiling"
     )
