@@ -182,10 +182,14 @@ class CancelToken:
 
     @property
     def reason(self) -> Optional[str]:
+        # lint-ok: lock-discipline: _reason is written exactly once,
+        # before _event.set(); readers that gate on the event see it
         return self._reason
 
     def raise_if_cancelled(self) -> None:
         if self._event.is_set():
+            # lint-ok: lock-discipline: read after _event.is_set() —
+            # Event.set() publishes the preceding _reason write
             raise RunCancelled(self._reason or "cancelled")
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -193,6 +197,8 @@ class CancelToken:
 
     def __repr__(self) -> str:
         state = (
+            # lint-ok: lock-discipline: debug snapshot; may lag a
+            # concurrent cancel by design
             f"cancelled: {self._reason!r}" if self.cancelled else "active"
         )
         return f"CancelToken({state})"
@@ -519,7 +525,7 @@ class AdmissionController:
         self._queue: "deque[int]" = deque()
         self._next_ticket = 0
 
-    def _admissible(
+    def _admissible_locked(
         self, limit: int, estimated_bytes: int, watermark_bytes: int
     ) -> bool:
         if limit > 0 and self._active >= limit:
@@ -554,7 +560,7 @@ class AdmissionController:
             # spent queued counts against the deadline (idempotent —
             # the scan supervisor re-starting it later is a no-op)
         with self._cond:
-            if not self._queue and self._admissible(
+            if not self._queue and self._admissible_locked(
                 limit, estimated_bytes, watermark_bytes
             ):
                 self._active += 1
@@ -567,7 +573,7 @@ class AdmissionController:
             try:
                 while not (
                     self._queue[0] == ticket
-                    and self._admissible(
+                    and self._admissible_locked(
                         limit, estimated_bytes, watermark_bytes
                     )
                 ):

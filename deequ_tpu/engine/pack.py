@@ -150,6 +150,8 @@ def packed_device_get(tree: Any) -> Any:
     # (tests/test_sync_discipline.py pins fetches; dashboards trend
     # bytes/fetch to catch a state blow-up before it costs seconds)
     get_telemetry().counter("engine.fetch_bytes").inc(
+        # lint-ok: trace-hazard: post-device_get accounting — `packed`
+        # is host numpy here; this IS the sanctioned sync epilogue
         int(sum(np.asarray(a).nbytes for a in packed.values()))
     )
     out = list(leaves)
@@ -158,7 +160,11 @@ def packed_device_get(tree: Any) -> Any:
         flat = packed[name]
         for i in members:
             shape = tuple(leaves[i].shape)
+            # lint-ok: trace-hazard: static shape arithmetic on the
+            # host side of the epilogue
             size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            # lint-ok: trace-hazard: slicing the already-fetched host
+            # buffer back into per-leaf views
             piece = np.asarray(flat[off:off + size])
             off += size
             out[i] = piece.reshape(shape) if shape else piece.reshape(())[()]

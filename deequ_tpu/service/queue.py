@@ -99,10 +99,14 @@ class RunHandle:
 
     @property
     def status(self) -> str:
+        # lint-ok: lock-discipline: monitoring snapshot — _state moves
+        # monotonically to a terminal value; a stale read is benign
         return self._state
 
     @property
     def done(self) -> bool:
+        # lint-ok: lock-discipline: monotonic state machine — once a
+        # terminal state is visible it never changes
         return self._state in RunState.TERMINAL
 
     def cancel(self, reason: str = "cancelled by client") -> None:
@@ -118,10 +122,18 @@ class RunHandle:
     def result(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
             raise TimeoutError(
+                # lint-ok: lock-discipline: best-effort status in an
+                # error message; may lag the transition that races the
+                # timeout
                 f"run {self.run_id} not finished (status={self._state})"
             )
+        # lint-ok: lock-discipline: _done.wait() returned True — the
+        # Event.set() in _finish publishes _error/_result (terminal
+        # state never changes after that)
         if self._error is not None:
+            # lint-ok: lock-discipline: post-Event read, see above
             raise self._error
+        # lint-ok: lock-discipline: post-Event read, see above
         return self._result
 
     # -- transitions (scheduler/queue internal) -------------------------
@@ -148,6 +160,8 @@ class RunHandle:
     def __repr__(self) -> str:
         return (
             f"RunHandle({self.run_id}, tenant={self.tenant!r}, "
+            # lint-ok: lock-discipline: debug snapshot of a monotonic
+            # state machine
             f"{Priority.name(self.priority)}, {self._state})"
         )
 

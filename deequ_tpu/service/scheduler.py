@@ -112,8 +112,11 @@ class Scheduler:
             )
             try:
                 result = self.execute(ticket)
-            except BaseException as exc:  # noqa: BLE001 — the handle is
-                # the error channel; a worker must survive any run
+            # lint-ok: interrupt-swallow: the handle is the error
+            # channel — _finish(FAILED, error=exc) carries everything
+            # (interrupts included) to result(); the worker thread
+            # itself must survive any run
+            except BaseException as exc:  # noqa: BLE001
                 handle.finished_at = self.clock.now()
                 handle._finish(RunState.FAILED, error=exc)
                 tm.counter("service.failed").inc()

@@ -1,0 +1,774 @@
+"""tools/staticcheck: framework, the four AST analyzers, the migrated
+token rules, and the whole-repo tier-1 gate.
+
+Per-rule fixtures follow one pattern: a PLANTED violation the analyzer
+must catch, and its corrected twin it must stay silent on — so every
+rule's detection logic is pinned against both false negatives and the
+obvious false positive.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.staticcheck import run_analyzers, summarize, to_json, unwaived
+from tools.staticcheck.__main__ import main as cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, rel, source):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return rel
+
+
+def _rules_found(tmp_path, rule=None):
+    findings = unwaived(run_analyzers(str(tmp_path)))
+    if rule is None:
+        return findings
+    return [f for f in findings if f.rule == rule]
+
+
+# --------------------------------------------------------------------------
+# the tier-1 gate: the shipped tree is clean
+# --------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_repo_has_zero_unwaived_findings(self):
+        """The staticcheck analogue of test_telemetry's lint gate: every
+        finding on the real tree is either fixed or carries a reasoned
+        waiver. New code that trips a rule fails HERE."""
+        findings = unwaived(run_analyzers(REPO_ROOT))
+        assert findings == [], "\n" + "\n".join(
+            f.render() for f in findings
+        )
+
+    def test_cli_exits_zero_on_repo(self, capsys):
+        assert cli_main([REPO_ROOT]) == 0
+        out = capsys.readouterr().out
+        assert "staticcheck: 0 finding(s)" in out
+
+    def test_repo_waivers_all_carry_reasons(self):
+        findings = run_analyzers(REPO_ROOT)
+        waived = [f for f in findings if f.waived]
+        assert waived, "expected the documented waiver sites to register"
+        assert all(f.waive_reason for f in waived)
+
+
+# --------------------------------------------------------------------------
+# lock-discipline / lock-order
+# --------------------------------------------------------------------------
+
+
+LOCK_VIOLATION = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n
+"""
+
+LOCK_CORRECTED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            with self._lock:
+                return self._n
+"""
+
+
+class TestLockDiscipline:
+    def test_catches_unlocked_read_of_protected_attr(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        found = _rules_found(tmp_path, "lock-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "_n"
+        assert "peek" in found[0].message
+
+    def test_silent_on_corrected_twin(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_CORRECTED)
+        assert _rules_found(tmp_path, "lock-discipline") == []
+
+    def test_locked_suffix_methods_are_lock_scope(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def _take_locked(self):
+                    return self._items.pop()
+            """,
+        )
+        assert _rules_found(tmp_path, "lock-discipline") == []
+
+    def test_container_mutation_counts_as_write(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def drain(self):
+                    self._items.clear()
+            """,
+        )
+        found = _rules_found(tmp_path, "lock-discipline")
+        assert len(found) == 1 and found[0].symbol == "_items"
+
+    def test_init_writes_do_not_flag(self, tmp_path):
+        # __init__ publishes before any concurrency exists
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_CORRECTED)
+        assert _rules_found(tmp_path, "lock-discipline") == []
+
+
+LOCK_ORDER_CYCLE = """
+    import threading
+
+    class Alpha:
+        def __init__(self, beta: "Beta"):
+            self._lock = threading.Lock()
+            self._x = 0
+            self._beta = beta
+
+        def advance(self):
+            with self._lock:
+                self._x += 1
+                self._beta.poke()
+
+        def poke(self):
+            with self._lock:
+                self._x += 1
+
+    class Beta:
+        def __init__(self, alpha: Alpha):
+            self._lock = threading.Lock()
+            self._y = 0
+            self._alpha = alpha
+
+        def advance(self):
+            with self._lock:
+                self._y += 1
+                self._alpha.poke()
+
+        def poke(self):
+            with self._lock:
+                self._y += 1
+"""
+
+LOCK_ORDER_DAG = """
+    import threading
+
+    class Alpha:
+        def __init__(self, beta: "Beta"):
+            self._lock = threading.Lock()
+            self._x = 0
+            self._beta = beta
+
+        def advance(self):
+            with self._lock:
+                self._x += 1
+                self._beta.poke()
+
+    class Beta:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._y = 0
+
+        def poke(self):
+            with self._lock:
+                self._y += 1
+"""
+
+
+class TestLockOrder:
+    def test_catches_cross_class_acquisition_cycle(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_ORDER_CYCLE)
+        found = _rules_found(tmp_path, "lock-order")
+        assert len(found) >= 1
+        assert "Alpha" in found[0].message and "Beta" in found[0].message
+
+    def test_silent_on_one_directional_dag(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_ORDER_DAG)
+        assert _rules_found(tmp_path, "lock-order") == []
+
+
+# --------------------------------------------------------------------------
+# interrupt-safety
+# --------------------------------------------------------------------------
+
+
+class TestInterruptSafety:
+    def test_catches_swallowing_bare_except(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def run(step):
+                try:
+                    step()
+                except:
+                    pass
+            """,
+        )
+        found = _rules_found(tmp_path, "interrupt-swallow")
+        assert len(found) == 1
+
+    def test_catches_swallowing_base_exception(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def run(step):
+                try:
+                    step()
+                except BaseException:
+                    return None
+            """,
+        )
+        assert len(_rules_found(tmp_path, "interrupt-swallow")) == 1
+
+    def test_silent_when_handler_reraises(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def run(step, log):
+                try:
+                    step()
+                except BaseException:
+                    log("interrupted")
+                    raise
+            """,
+        )
+        assert _rules_found(tmp_path, "interrupt-swallow") == []
+
+    def test_catches_named_interrupt_without_reraise(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            from deequ_tpu.engine.deadline import ScanInterrupted
+
+            def run(step):
+                try:
+                    step()
+                except ScanInterrupted:
+                    return "partial"
+            """,
+        )
+        found = _rules_found(tmp_path, "interrupt-named")
+        assert len(found) == 1 and found[0].symbol == "ScanInterrupted"
+
+    def test_silent_on_named_interrupt_with_reraise(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            from deequ_tpu.engine.deadline import ScanInterrupted
+
+            def run(step, checkpoint):
+                try:
+                    step()
+                except ScanInterrupted:
+                    checkpoint()
+                    raise
+            """,
+        )
+        assert _rules_found(tmp_path, "interrupt-named") == []
+
+    def test_silent_on_plain_except_exception(self, tmp_path):
+        # the tunnel exists so that except Exception is SAFE
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def run(step):
+                try:
+                    step()
+                except Exception:
+                    return None
+            """,
+        )
+        assert _rules_found(tmp_path) == []
+
+
+# --------------------------------------------------------------------------
+# trace-hazard
+# --------------------------------------------------------------------------
+
+
+class TestTraceHazard:
+    def test_catches_host_coercion_in_jitted_function(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return float(jnp.sum(x))
+            """,
+        )
+        found = _rules_found(tmp_path, "trace-hazard")
+        assert len(found) == 1 and found[0].symbol == "float"
+
+    def test_silent_on_corrected_twin(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return jnp.sum(x).astype(jnp.float32)
+            """,
+        )
+        assert _rules_found(tmp_path, "trace-hazard") == []
+
+    def test_catches_np_call_on_traced_value(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def step(x):
+                y = jnp.abs(x)
+                return np.cumsum(y)
+            """,
+        )
+        found = _rules_found(tmp_path, "trace-hazard")
+        assert len(found) == 1 and found[0].symbol == "np.cumsum"
+
+    def test_catches_python_if_on_traced_operand(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import jax.numpy as jnp
+
+            def step(x):
+                if jnp.any(x > 0):
+                    return x
+                return -x
+            """,
+        )
+        found = _rules_found(tmp_path, "trace-hazard")
+        assert len(found) == 1 and found[0].symbol == "if"
+
+    def test_dtype_dispatch_if_is_static_and_legal(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import jax.numpy as jnp
+
+            def step(x):
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return jnp.nan_to_num(x)
+                return x
+            """,
+        )
+        assert _rules_found(tmp_path, "trace-hazard") == []
+
+    def test_traced_set_propagates_through_scan_step(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            from jax import lax
+
+            def _fold(carry, item):
+                return carry + item.item(), None
+
+            def run(items, init):
+                return lax.scan(_fold, init, items)
+            """,
+        )
+        found = _rules_found(tmp_path, "trace-hazard")
+        assert len(found) == 1 and found[0].symbol == "item"
+
+    def test_host_only_module_is_untouched(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import numpy as np
+
+            def fold(parts):
+                return float(np.sum(np.asarray(parts)))
+            """,
+        )
+        assert _rules_found(tmp_path, "trace-hazard") == []
+
+
+# --------------------------------------------------------------------------
+# plan-key discipline
+# --------------------------------------------------------------------------
+
+
+PLANKEY_VIOLATION = """
+    from deequ_tpu import config
+
+    def _plan_cache_key(ops):
+        return tuple(op.cache_token for op in ops)
+
+    def prepare_scan(dataset, ops):
+        opts = config.options()
+        size = opts.batch_size
+        return (_plan_cache_key(ops), size)
+"""
+
+PLANKEY_CORRECTED = """
+    from deequ_tpu import config
+
+    PLAN_KEY_COVERED_CONFIG = {
+        "batch_size": "traces are shape-specialized per batch geometry",
+    }
+
+    def _plan_cache_key(ops):
+        return tuple(op.cache_token for op in ops)
+
+    def prepare_scan(dataset, ops):
+        opts = config.options()
+        size = opts.batch_size
+        return (_plan_cache_key(ops), size)
+"""
+
+
+class TestPlanKey:
+    def test_catches_unkeyed_config_read(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/engine/myscan.py", PLANKEY_VIOLATION)
+        found = _rules_found(tmp_path, "plan-key")
+        assert len(found) == 1 and found[0].symbol == "batch_size"
+
+    def test_silent_when_covered_constant_documents_it(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/engine/myscan.py", PLANKEY_CORRECTED)
+        assert _rules_found(tmp_path, "plan-key") == []
+
+    def test_silent_when_key_itself_reads_the_attr(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/myscan.py",
+            """
+            from deequ_tpu import config
+
+            def _plan_cache_key(ops):
+                return (tuple(ops), config.options().batch_size)
+
+            def prepare_scan(dataset, ops):
+                size = config.options().batch_size
+                return (_plan_cache_key(ops), size)
+            """,
+        )
+        assert _rules_found(tmp_path, "plan-key") == []
+
+    def test_reaches_reads_through_helper_calls(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/myscan.py",
+            """
+            from deequ_tpu import config
+
+            def _plan_cache_key(ops):
+                return tuple(ops)
+
+            def _resolve_engine():
+                return config.options().engine
+
+            def prepare_scan(dataset, ops):
+                eng = _resolve_engine()
+                return (_plan_cache_key(ops), eng)
+            """,
+        )
+        found = _rules_found(tmp_path, "plan-key")
+        assert len(found) == 1 and found[0].symbol == "engine"
+
+    def test_execute_path_reads_are_out_of_scope(self, tmp_path):
+        # config reads OUTSIDE the prepare_scan closure don't flag —
+        # they affect execution, not the trace the key guards
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/myscan.py",
+            """
+            from deequ_tpu import config
+
+            def _plan_cache_key(ops):
+                return tuple(ops)
+
+            def prepare_scan(dataset, ops):
+                return _plan_cache_key(ops)
+
+            def execute_plan(plan):
+                return config.options().scan_retry
+            """,
+        )
+        assert _rules_found(tmp_path, "plan-key") == []
+
+
+# --------------------------------------------------------------------------
+# waivers
+# --------------------------------------------------------------------------
+
+
+class TestWaivers:
+    def test_trailing_waiver_suppresses_named_rule(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    return self._n  # lint-ok: lock-discipline: snapshot
+            """,
+        )
+        findings = run_analyzers(str(tmp_path))
+        assert unwaived(findings) == []
+        waived = [f for f in findings if f.waived]
+        assert len(waived) == 1 and waived[0].waive_reason == "snapshot"
+
+    def test_standalone_waiver_covers_next_code_line(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    # lint-ok: lock-discipline: monitoring snapshot
+                    return self._n
+            """,
+        )
+        assert unwaived(run_analyzers(str(tmp_path))) == []
+
+    def test_waiver_for_other_rule_does_not_suppress(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    return self._n  # lint-ok: trace-hazard: wrong rule
+            """,
+        )
+        assert len(_rules_found(tmp_path, "lock-discipline")) == 1
+
+    def test_legacy_sync_ok_maps_to_sync_discipline(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            """
+            import jax
+
+            def drain(state):
+                return jax.device_get(state)  # sync-ok: checkpoint drain
+            """,
+        )
+        findings = run_analyzers(str(tmp_path))
+        sync = [f for f in findings if f.rule == "sync-discipline"]
+        assert len(sync) == 1 and sync[0].waived
+        assert sync[0].waive_reason == "checkpoint drain"
+
+
+# --------------------------------------------------------------------------
+# the tokenize regression (satellite 1) + shim compat
+# --------------------------------------------------------------------------
+
+
+class TestMalformedFiles:
+    def test_unparseable_fixture_degrades_to_findings(self, tmp_path):
+        """The TokenizeError regression: an unterminated triple quote
+        raises tokenize.TokenError; the old scanner referenced the
+        nonexistent tokenize.TokenizeError and died with
+        AttributeError on first contact."""
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/broken.py",
+            'x = """unterminated\n',
+        )
+        findings = run_analyzers(str(tmp_path))  # must not raise
+        rules = {f.rule for f in findings}
+        assert "tokenize-error" in rules
+        assert "parse-error" in rules
+
+    def test_shim_reports_legacy_tokenize_error_tuple(self, tmp_path):
+        from tools.telemetry_lint import find_violations
+
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/broken.py",
+            'x = """unterminated\n',
+        )
+        assert find_violations(str(tmp_path)) == [
+            ("deequ_tpu/engine/broken.py", 0, "<tokenize error>")
+        ]
+
+    def test_shim_delegates_to_framework(self, tmp_path):
+        """The shim's tuples are exactly the framework's unwaived
+        token-rule findings."""
+        from tools.telemetry_lint import TOKEN_RULES, find_violations
+
+        _write(
+            tmp_path,
+            "deequ_tpu/service/rogue.py",
+            "import time\nnow = time.monotonic()\n",
+        )
+        tuples = find_violations(str(tmp_path))
+        findings = unwaived(
+            run_analyzers(str(tmp_path), rules=list(TOKEN_RULES))
+        )
+        assert tuples == [(f.path, f.line, f.symbol) for f in findings]
+        assert ("deequ_tpu/service/rogue.py", 2, "monotonic") in tuples
+
+
+# --------------------------------------------------------------------------
+# CLI / JSON artifact
+# --------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_exit_one_and_listing_on_violation(self, tmp_path, capsys):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        assert cli_main([str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "[lock-discipline]" in out
+        assert "staticcheck: 1 finding(s)" in out
+
+    def test_json_artifact_is_machine_readable(self, tmp_path, capsys):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        assert cli_main([str(tmp_path), "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["summary"]["unwaived"] == 1
+        assert payload["summary"]["by_rule"] == {"lock-discipline": 1}
+        (finding,) = payload["findings"]
+        assert finding["rule"] == "lock-discipline"
+        assert finding["path"] == "deequ_tpu/service/fixture.py"
+        assert finding["line"] > 0
+
+    def test_rules_flag_narrows_the_run(self, tmp_path, capsys):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        assert cli_main([str(tmp_path), "--rules", "trace-hazard"]) == 0
+        assert cli_main([str(tmp_path), "--rules", "lock-discipline"]) == 1
+
+    def test_list_rules_prints_catalog(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in (
+            "lock-discipline",
+            "lock-order",
+            "interrupt-swallow",
+            "interrupt-named",
+            "trace-hazard",
+            "plan-key",
+            "sync-discipline",
+        ):
+            assert f"{rule}:" in out
+
+    def test_nonexistent_root_is_an_error_not_a_clean_pass(self, tmp_path):
+        with pytest.raises(SystemExit) as excinfo:
+            cli_main([str(tmp_path / "no-such-dir")])
+        assert excinfo.value.code == 2
+
+    def test_summarize_matches_json_summary(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        findings = run_analyzers(str(tmp_path))
+        blob = json.loads(to_json(findings, str(tmp_path)))
+        assert blob["summary"] == summarize(findings)
+
+
+class TestObsReport:
+    def test_staticcheck_summary_line(self):
+        from tools.obs_report import render_staticcheck
+
+        line = render_staticcheck(REPO_ROOT)
+        assert line.startswith("staticcheck: 0 finding(s), ")
+        assert line.endswith("(clean)")
+
+    def test_staticcheck_flag_without_path(self, capsys):
+        from tools.obs_report import main as report_main
+
+        assert report_main(["--staticcheck"]) == 0
+        assert capsys.readouterr().out.startswith("staticcheck:")
+
+    def test_staticcheck_line_reports_failing_tree(self, tmp_path):
+        from tools.obs_report import render_staticcheck
+
+        _write(tmp_path, "deequ_tpu/service/fixture.py", LOCK_VIOLATION)
+        line = render_staticcheck(str(tmp_path))
+        assert line.startswith("staticcheck: 1 finding(s)")
+        assert "FAILING" in line

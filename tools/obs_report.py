@@ -5,11 +5,16 @@ Usage:
     python -m tools.obs_report runs.jsonl            # all runs
     python -m tools.obs_report runs.jsonl --run 3    # one run
     python -m tools.obs_report runs.jsonl --counters # counter totals only
+    python -m tools.obs_report --staticcheck         # lint health line
 
 The artifact is produced by ``deequ_tpu.telemetry.configure(
 jsonl_path=...)`` (or ``DEEQU_TPU_TELEMETRY_JSONL``); every finished
 span, engine event, and run summary is one JSON line. See
 docs/OBSERVABILITY.md for line shapes and the counter catalog.
+``--staticcheck`` appends (or, without a path, just prints) the
+one-line static-analysis summary from ``tools.staticcheck``
+(docs/STATIC_ANALYSIS.md) so an ops report carries lint health next
+to runtime health.
 """
 
 from __future__ import annotations
@@ -301,6 +306,29 @@ def render_service(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_staticcheck(root: Optional[str] = None) -> str:
+    """One-line static-analysis health summary, e.g. ``staticcheck: 0
+    finding(s), 29 waived across 12 rules (clean)``."""
+    from tools.staticcheck import all_rules, run_analyzers, summarize
+
+    from_root = root
+    if from_root is None:
+        from tools.staticcheck import default_root
+
+        from_root = default_root()
+    stats = summarize(run_analyzers(from_root))
+    verdict = (
+        "clean"
+        if stats["unwaived"] == 0
+        else "FAILING — run python -m tools.staticcheck"
+    )
+    return (
+        f"staticcheck: {stats['unwaived']} finding(s), "
+        f"{stats['waived']} waived across {len(all_rules())} rules "
+        f"({verdict})"
+    )
+
+
 def render(
     records: List[Dict[str, Any]],
     run_id: Optional[int] = None,
@@ -346,7 +374,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="Render run breakdowns from a telemetry JSONL artifact"
     )
-    parser.add_argument("path", help="telemetry JSONL file")
+    parser.add_argument(
+        "path", nargs="?", default=None, help="telemetry JSONL file"
+    )
     parser.add_argument(
         "--run", type=int, default=None, help="render only this run_id"
     )
@@ -358,7 +388,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--service", action="store_true",
         help="print only the multi-tenant service section",
     )
+    parser.add_argument(
+        "--staticcheck", action="store_true",
+        help="append the one-line static-analysis summary "
+        "(tools.staticcheck); usable without a JSONL path",
+    )
     args = parser.parse_args(argv)
+    if args.path is None:
+        if not args.staticcheck:
+            parser.error("a telemetry JSONL path is required "
+                         "(or pass --staticcheck)")
+        print(render_staticcheck())
+        return 0
     try:
         records = read_jsonl(args.path)
     except OSError as exc:
@@ -370,6 +411,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         counters_only=args.counters,
         service_only=args.service,
     ))
+    if args.staticcheck:
+        print(render_staticcheck())
     return 0
 
 

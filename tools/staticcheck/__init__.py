@@ -1,0 +1,36 @@
+"""AST-based static-analysis suite for the deequ_tpu tree.
+
+Importing this package registers the default analyzers (lock
+discipline, interrupt safety, trace hazards, plan-key discipline, and
+the token rules migrated from tools.telemetry_lint) on the shared
+registry. Entry points:
+
+    python -m tools.staticcheck [root] [--json] [--rules a,b] [--all]
+
+and, from tests, :func:`tools.staticcheck.run` — returns the finding
+list the tier-1 gate asserts empty. See docs/STATIC_ANALYSIS.md.
+"""
+
+from tools.staticcheck.core import (  # noqa: F401
+    Analyzer,
+    Finding,
+    SourceFile,
+    all_analyzers,
+    all_rules,
+    collect_files,
+    default_root,
+    register,
+    run_analyzers,
+    summarize,
+    to_json,
+    unwaived,
+)
+
+# importing the analyzer modules registers the default suite
+from tools.staticcheck import interrupts as _interrupts  # noqa: F401,E402
+from tools.staticcheck import locks as _locks  # noqa: F401,E402
+from tools.staticcheck import plankey as _plankey  # noqa: F401,E402
+from tools.staticcheck import tokens as _tokens  # noqa: F401,E402
+from tools.staticcheck import trace as _trace  # noqa: F401,E402
+
+run = run_analyzers

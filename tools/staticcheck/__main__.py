@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.staticcheck [root] [options]``.
+
+Exit status 0 means zero unwaived findings (the tier-1 gate and CI
+both key off this); 1 means at least one. ``--json`` emits the full
+machine-readable artifact (summary + every finding, waived ones
+included and marked) for tooling; ``--all`` shows waived findings in
+the human listing too; ``--rules`` narrows to a comma-separated rule
+subset; ``--list-rules`` prints the catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+from tools.staticcheck import (
+    all_rules,
+    default_root,
+    run_analyzers,
+    summarize,
+    to_json,
+    unwaived,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.staticcheck",
+        description="AST-based static analysis for the deequ_tpu tree",
+    )
+    parser.add_argument("root", nargs="?", default=None)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument(
+        "--rules", default=None, help="comma-separated rule subset"
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="also list waived findings in human output",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, description in all_rules():
+            print(f"{rule}: {description}")
+        return 0
+    root = args.root or default_root()
+    if not os.path.isdir(root):
+        parser.error(f"root is not a directory: {root}")
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    findings = run_analyzers(root, rules=rules)
+    if args.as_json:
+        print(to_json(findings, root))
+        return 1 if unwaived(findings) else 0
+    shown = findings if args.all else unwaived(findings)
+    for finding in shown:
+        print(finding.render())
+    stats = summarize(findings)
+    print(
+        f"staticcheck: {stats['unwaived']} finding(s), "
+        f"{stats['waived']} waived"
+    )
+    return 1 if stats["unwaived"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,362 @@
+"""The staticcheck framework: file model, waivers, registry, runner.
+
+Everything an analyzer needs arrives as a :class:`SourceFile` — the
+raw source, the ``ast`` tree (or the parse error), the token stream
+(or the tokenize error), and the per-line waiver map — so individual
+analyzers never re-read or re-parse, and a malformed file degrades to
+ONE ``parse-error`` finding instead of crashing the run (the exact
+failure mode the old ``tokenize.TokenizeError`` AttributeError hid;
+the real name is ``tokenize.TokenError``).
+
+Waiver syntax (docs/STATIC_ANALYSIS.md):
+
+    x = thing()  # lint-ok: <rule>[,<rule2>]: <reason>
+
+A trailing waiver covers its own line; a waiver comment ALONE on a
+line covers the next code line (for statements too long to share a
+line with a reason). The rule list must name the rule being waived
+(``*`` waives any rule — discouraged) and the reason is mandatory:
+an unreasoned waiver is itself a finding. The legacy ``# sync-ok:
+<reason>`` marker (PR 6) is accepted as a same-line waiver for the
+``sync-discipline`` rule so the six existing engine sites keep
+working unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: directories scanned by default, relative to the repo root. Analyzers
+#: narrow further by path prefix; the framework only decides what gets
+#: parsed at all (product code — tools/tests lint themselves via pytest).
+DEFAULT_SCAN_DIRS = ("deequ_tpu",)
+
+WAIVER_RE = re.compile(
+    r"#\s*lint-ok:\s*(?P<rules>[\w*][\w*,\s-]*?):\s*(?P<reason>.+)"
+)
+LEGACY_SYNC_RE = re.compile(r"#\s*sync-ok:\s*(?P<reason>.+)")
+
+
+@dataclass
+class Finding:
+    """One analyzer finding, anchored to a repo-relative line."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""  # the offending token/attribute, when one exists
+    waived: bool = False
+    waive_reason: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+        if self.symbol:
+            out["symbol"] = self.symbol
+        if self.waived:
+            out["waived"] = True
+            out["waive_reason"] = self.waive_reason
+        return out
+
+    def render(self) -> str:
+        tag = " (waived)" if self.waived else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class Waiver:
+    rules: Tuple[str, ...]
+    reason: str
+    line: int  # the line the waiver COVERS
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+@dataclass
+class SourceFile:
+    """One parsed module, shared by every analyzer."""
+
+    rel: str  # repo-relative path, forward slashes
+    path: str  # absolute path
+    source: str
+    tree: Optional[ast.AST] = None
+    parse_error: Optional[str] = None
+    tokens: List[Any] = field(default_factory=list)
+    token_error: Optional[str] = None
+    waivers: Dict[int, List[Waiver]] = field(default_factory=dict)
+
+    def waiver_for(self, rule: str, line: int) -> Optional[Waiver]:
+        for waiver in self.waivers.get(line, ()):
+            if waiver.covers(rule):
+                return waiver
+        return None
+
+
+def _extract_waivers(
+    source: str, tokens: Sequence[Any]
+) -> Dict[int, List[Waiver]]:
+    lines = source.splitlines()
+    waivers: Dict[int, List[Waiver]] = {}
+
+    def add(line: int, waiver: Waiver) -> None:
+        waivers.setdefault(line, []).append(waiver)
+
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        comment_line = tok.start[0]
+        match = WAIVER_RE.search(tok.string)
+        legacy = LEGACY_SYNC_RE.search(tok.string)
+        if match is None and legacy is None:
+            continue
+        if match is not None:
+            rules = tuple(
+                r.strip() for r in match.group("rules").split(",") if r.strip()
+            )
+            reason = match.group("reason").strip()
+        else:
+            rules = ("sync-discipline",)
+            reason = legacy.group("reason").strip()
+        before = (
+            lines[comment_line - 1][: tok.start[1]]
+            if comment_line - 1 < len(lines)
+            else ""
+        )
+        if before.strip():
+            covered = comment_line  # trailing: waives its own line
+        else:
+            # standalone: waives the next non-blank, non-comment line
+            covered = comment_line + 1
+            while covered - 1 < len(lines):
+                text = lines[covered - 1].strip()
+                if text and not text.startswith("#"):
+                    break
+                covered += 1
+        target = Waiver(rules=rules, reason=reason, line=covered)
+        add(covered, target)
+        # a legacy sync-ok trailing a continuation also covers the line
+        # the comment sits on (the historical behavior)
+        if legacy is not None and covered != comment_line:
+            add(comment_line, Waiver(rules=rules, reason=reason,
+                                     line=comment_line))
+    return waivers
+
+
+def load_source_file(root: str, rel: str) -> SourceFile:
+    path = os.path.join(root, rel.replace("/", os.sep))
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    source = raw.decode("utf-8", errors="replace")
+    sf = SourceFile(rel=rel, path=path, source=source)
+    try:
+        sf.tokens = list(tokenize.tokenize(io.BytesIO(raw).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError) as exc:
+        sf.token_error = f"{type(exc).__name__}: {exc}"
+    try:
+        sf.tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:
+        sf.parse_error = f"{type(exc).__name__}: {exc.msg} (line {exc.lineno})"
+    sf.waivers = _extract_waivers(source, sf.tokens)
+    return sf
+
+
+def collect_files(
+    root: str, scan_dirs: Sequence[str] = DEFAULT_SCAN_DIRS
+) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    for rel_dir in scan_dirs:
+        top = os.path.join(root, rel_dir)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(top):
+            for filename in sorted(filenames):
+                if not filename.endswith(".py"):
+                    continue
+                rel = os.path.relpath(
+                    os.path.join(dirpath, filename), root
+                ).replace(os.sep, "/")
+                files.append(load_source_file(root, rel))
+    files.sort(key=lambda f: f.rel)
+    return files
+
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+
+class Analyzer:
+    """Base class: subclass, set ``name``/``rules``/``description``,
+    implement ``analyze``. Registration is explicit (``register``), so
+    importing the package wires the default suite exactly once."""
+
+    name: str = ""
+    rules: Tuple[str, ...] = ()
+    description: str = ""
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: "Dict[str, Analyzer]" = {}
+
+
+def register(analyzer: Analyzer) -> Analyzer:
+    if not analyzer.name or not analyzer.rules:
+        raise ValueError("analyzer needs a name and at least one rule")
+    _REGISTRY[analyzer.name] = analyzer
+    return analyzer
+
+
+def all_analyzers() -> List[Analyzer]:
+    return list(_REGISTRY.values())
+
+
+def all_rules() -> List[Tuple[str, str]]:
+    """(rule, owning-analyzer description) pairs, for ``--list-rules``."""
+    out = []
+    for analyzer in _REGISTRY.values():
+        for rule in analyzer.rules:
+            out.append((rule, analyzer.description))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------------
+# Runner
+# --------------------------------------------------------------------------
+
+
+def run_analyzers(
+    root: str,
+    rules: Optional[Sequence[str]] = None,
+    scan_dirs: Sequence[str] = DEFAULT_SCAN_DIRS,
+) -> List[Finding]:
+    """Run every registered analyzer over ``root`` and apply waivers.
+    Returns ALL findings (waived ones carry ``waived=True``); callers
+    gate on the unwaived subset. A file that fails to parse yields one
+    ``parse-error`` finding and is skipped by the AST analyzers."""
+    files = collect_files(root, scan_dirs)
+    findings: List[Finding] = []
+    wanted = set(rules) if rules else None
+    if wanted is None or "parse-error" in wanted:
+        for sf in files:
+            if sf.parse_error is not None:
+                findings.append(
+                    Finding(
+                        rule="parse-error",
+                        path=sf.rel,
+                        line=0,
+                        message=f"cannot parse module: {sf.parse_error}",
+                    )
+                )
+    for analyzer in all_analyzers():
+        if wanted is not None and not wanted.intersection(analyzer.rules):
+            continue
+        for finding in analyzer.analyze(files, root):
+            if wanted is not None and finding.rule not in wanted:
+                continue
+            findings.append(finding)
+    by_rel = {sf.rel: sf for sf in files}
+    for finding in findings:
+        sf = by_rel.get(finding.path)
+        if sf is None:
+            continue
+        waiver = sf.waiver_for(finding.rule, finding.line)
+        if waiver is not None:
+            finding.waived = True
+            finding.waive_reason = waiver.reason
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def unwaived(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.waived]
+
+
+def summarize(findings: Sequence[Finding]) -> Dict[str, Any]:
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        if not f.waived:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "total": len(findings),
+        "unwaived": sum(1 for f in findings if not f.waived),
+        "waived": sum(1 for f in findings if f.waived),
+        "by_rule": by_rule,
+    }
+
+
+def to_json(findings: Sequence[Finding], root: str) -> str:
+    return json.dumps(
+        {
+            "root": root,
+            "summary": summarize(findings),
+            "findings": [f.to_dict() for f in findings],
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def default_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+# -- small shared AST helpers ----------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def annotation_class(node: Optional[ast.AST]) -> Optional[str]:
+    """The class name an annotation resolves to, unwrapping
+    Optional[X] / List[X] / "X" string forms; None when it isn't a
+    simple class reference."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        outer = dotted_name(node.value) or ""
+        if outer.split(".")[-1] in (
+            "Optional", "List", "Sequence", "Iterable", "Tuple", "Set",
+            "FrozenSet", "Deque",
+        ):
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[0]
+            return annotation_class(inner)
+        return None
+    name = dotted_name(node)
+    if name is None:
+        return None
+    return name.split(".")[-1]

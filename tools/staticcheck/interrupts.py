@@ -1,0 +1,121 @@
+"""Interrupt-safety analyzer: protect the BaseException tunnel.
+
+``ScanInterrupted`` (engine/deadline.py) and ``ScanKilled``
+(engine/resilience.py) derive from ``BaseException`` ON PURPOSE: they
+must tunnel through the ``except Exception`` retry/quarantine
+machinery untouched (docs/RESILIENCE.md). Two handler shapes can break
+that contract:
+
+- ``interrupt-swallow``: a bare ``except:`` or an ``except
+  BaseException`` handler with no ``raise`` anywhere in its body. Such
+  a handler eats a deadline/cancel/kill signal and keeps running — the
+  exact bug class the tunnel exists to rule out. A handler that
+  re-raises (even conditionally) is fine; a handler that forwards the
+  exception into an error channel instead of raising needs a waiver
+  naming that channel.
+- ``interrupt-named``: a handler that names a member of the interrupt
+  family (``ScanInterrupted``/``ScanKilled``) without re-raising.
+  Catching the family is reserved for the engine's sanctioned
+  clean-exit sites (checkpoint + partial result in engine/scan.py);
+  anywhere else must re-raise or carry a waiver explaining why this
+  site is allowed to terminate the tunnel.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+SCOPE_PREFIX = "deequ_tpu/"
+
+INTERRUPT_NAMES = frozenset({"ScanInterrupted", "ScanKilled"})
+
+
+def _handler_type_names(node: Optional[ast.AST]) -> List[str]:
+    """Class names a handler catches ('' for a bare ``except:``)."""
+    if node is None:
+        return [""]
+    if isinstance(node, ast.Tuple):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_handler_type_names(elt))
+        return out
+    name = dotted_name(node)
+    if name is None:
+        return []
+    return [name.split(".")[-1]]
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when any ``raise`` appears in the handler body (including
+    conditional re-raise — flow-insensitive by design: a handler that
+    CAN re-raise was written with the tunnel in mind)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+class InterruptSafetyAnalyzer(Analyzer):
+    name = "interrupts"
+    rules = ("interrupt-swallow", "interrupt-named")
+    description = (
+        "broad exception handlers that can swallow the "
+        "ScanInterrupted/ScanKilled BaseException tunnel"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if not sf.rel.startswith(SCOPE_PREFIX) or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                caught = _handler_type_names(node.type)
+                reraises = _reraises(node)
+                if ("" in caught or "BaseException" in caught) and (
+                    not reraises
+                ):
+                    what = (
+                        "bare 'except:'"
+                        if "" in caught
+                        else "'except BaseException'"
+                    )
+                    yield Finding(
+                        rule="interrupt-swallow",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{what} without re-raise can swallow the "
+                            "ScanInterrupted/ScanKilled tunnel "
+                            "(docs/RESILIENCE.md)"
+                        ),
+                        symbol="BaseException" if "" not in caught else "",
+                    )
+                named = sorted(set(caught) & INTERRUPT_NAMES)
+                if named and not reraises:
+                    yield Finding(
+                        rule="interrupt-named",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"handler catches {'/'.join(named)} without "
+                            "re-raising — terminating the interrupt "
+                            "tunnel is reserved for the engine's "
+                            "sanctioned clean-exit sites"
+                        ),
+                        symbol=named[0],
+                    )
+
+
+register(InterruptSafetyAnalyzer())

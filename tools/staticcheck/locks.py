@@ -1,0 +1,464 @@
+"""Lock-discipline analyzer: per-class protected-attribute inference
+plus a cross-class lock-acquisition graph.
+
+Scope: the threaded modules — ``deequ_tpu/service/`` and the engine's
+deadline/resilience/scan machinery. Two rules:
+
+``lock-discipline`` — for every class owning a ``threading.Lock``/
+``RLock``/``Condition`` attribute, the protected set is inferred as
+"attributes written inside ``with self._lock:`` (or ``self._cond``,
+which aliases the same lock per the repo's ``Condition(self._lock)``
+convention) in any non-``__init__`` method, or anywhere in a
+``*_locked`` method (the caller-holds-the-lock naming convention)".
+Every read or write of a protected attribute outside a lock scope and
+outside ``__init__``/``*_locked`` methods is flagged. Lock-free read
+paths that are deliberate (e.g. a monitoring ``status`` property
+reading a monotonic state machine) take a reasoned waiver.
+
+``lock-order`` — a digraph over class locks: an edge A→B means some
+method acquires B's lock while lexically holding A's. Built from a
+flow-insensitive type environment (annotations, dataclass fields,
+constructor assignments) and per-method acquisition summaries computed
+to a fixed point, so ``RunQueue._resolve_dead`` calling
+``handle._finish`` (which takes ``RunHandle._lock``) contributes
+RunQueue→RunHandle. A cycle is a lock-order inversion — two threads
+entering from opposite ends deadlock — and fails the build. Same-class
+edges are NOT emitted: parent/child instances of one class share a
+graph node and re-entry is already visible as a self-deadlock at
+runtime, while the legitimate pattern (iterate children outside the
+lock) would false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    annotation_class,
+    dotted_name,
+    register,
+)
+
+SCOPE_PREFIXES = ("deequ_tpu/service/",)
+SCOPE_FILES = (
+    "deequ_tpu/engine/deadline.py",
+    "deequ_tpu/engine/resilience.py",
+    "deequ_tpu/engine/scan.py",
+)
+
+LOCK_FACTORY_TAILS = frozenset({"Lock", "RLock", "Condition"})
+INIT_METHODS = frozenset({"__init__", "__post_init__", "__del__"})
+#: mutating container methods — a call like ``self._queued.append(x)``
+#: is a WRITE to ``_queued`` for protection inference
+MUTATORS = frozenset(
+    {
+        "append", "appendleft", "extend", "insert", "remove", "pop",
+        "popleft", "popitem", "clear", "add", "discard", "update",
+        "setdefault", "move_to_end", "sort", "reverse",
+    }
+)
+
+
+def _in_scope(rel: str) -> bool:
+    return rel in SCOPE_FILES or any(
+        rel.startswith(p) for p in SCOPE_PREFIXES
+    )
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    node: ast.ClassDef
+    lock_attrs: Set[str] = field(default_factory=set)  # incl. aliases
+    protected: Set[str] = field(default_factory=set)
+    #: attr -> class name, from annotations/constructor calls
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_class(node: ast.ClassDef, rel: str) -> ClassInfo:
+    info = ClassInfo(name=node.name, rel=rel, node=node)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+        elif isinstance(item, ast.AnnAssign) and isinstance(
+            item.target, ast.Name
+        ):
+            cls = annotation_class(item.annotation)
+            if cls:
+                info.attr_types[item.target.id] = cls
+    # lock attributes + constructor-derived attr types
+    for method in info.methods.values():
+        args = getattr(method, "args", None)
+        param_types: Dict[str, str] = {}
+        if args is not None:
+            for arg in list(args.args) + list(args.kwonlyargs):
+                cls = annotation_class(arg.annotation)
+                if cls:
+                    param_types[arg.arg] = cls
+        for sub in ast.walk(method):
+            target_attr = None
+            value = None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                target_attr = _self_attr(sub.targets[0])
+                value = sub.value
+            elif isinstance(sub, ast.AnnAssign):
+                target_attr = _self_attr(sub.target)
+                value = sub.value
+                cls = annotation_class(sub.annotation)
+                if target_attr and cls:
+                    info.attr_types[target_attr] = cls
+            if target_attr is None or value is None:
+                continue
+            if isinstance(value, ast.Name) and value.id in param_types:
+                # ``self._q = q`` with an annotated parameter ``q: Queue``
+                info.attr_types.setdefault(
+                    target_attr, param_types[value.id]
+                )
+            if isinstance(value, ast.Call):
+                callee = dotted_name(value.func) or ""
+                tail = callee.split(".")[-1]
+                if tail in LOCK_FACTORY_TAILS:
+                    info.lock_attrs.add(target_attr)
+                    # Condition(self._lock) aliases the named lock; a
+                    # bare Condition() is its own lock — either way the
+                    # attr is a lock handle on this class's node
+                elif tail and tail[0].isupper():
+                    info.attr_types.setdefault(target_attr, tail)
+    return info
+
+
+@dataclass
+class _Access:
+    attr: str
+    line: int
+    write: bool
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """One pass over a method: attribute accesses tagged with whether
+    the class lock is lexically held, plus calls made while holding."""
+
+    def __init__(self, info: ClassInfo, held_at_entry: bool) -> None:
+        self.info = info
+        self.held = held_at_entry
+        self.locked_accesses: List[_Access] = []
+        self.unlocked_accesses: List[_Access] = []
+        #: (callee dotted name, line, held) — for the lock graph
+        self.calls: List[Tuple[str, int, bool]] = []
+
+    def _is_lock_with(self, item: ast.withitem) -> bool:
+        attr = _self_attr(item.context_expr)
+        return attr is not None and attr in self.info.lock_attrs
+
+    def visit_With(self, node: ast.With) -> None:
+        takes = any(self._is_lock_with(item) for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        prev = self.held
+        if takes:
+            self.held = True
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = prev
+
+    def _record(self, attr: str, line: int, write: bool) -> None:
+        if attr in self.info.lock_attrs:
+            return
+        access = _Access(attr=attr, line=line, write=write)
+        (self.locked_accesses if self.held
+         else self.unlocked_accesses).append(access)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(
+                attr, node.lineno, isinstance(node.ctx, (ast.Store, ast.Del))
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = dotted_name(node.func)
+        if callee:
+            self.calls.append((callee, node.lineno, self.held))
+            # mutating method on a self attribute counts as a write
+            parts = callee.split(".")
+            if (
+                len(parts) == 3
+                and parts[0] == "self"
+                and parts[2] in MUTATORS
+            ):
+                self._record(parts[1], node.lineno, True)
+        self.generic_visit(node)
+
+    # nested defs inherit the held state they're defined under (they
+    # almost always run inline in this codebase); don't reset it
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.generic_visit(node)
+
+
+def _scan_method(
+    info: ClassInfo, name: str, method: ast.AST
+) -> _MethodScanner:
+    held_at_entry = name.endswith("_locked")
+    scanner = _MethodScanner(info, held_at_entry)
+    for stmt in method.body:  # skip decorators/defaults
+        scanner.visit(stmt)
+    return scanner
+
+
+class LockDisciplineAnalyzer(Analyzer):
+    name = "locks"
+    rules = ("lock-discipline", "lock-order")
+    description = (
+        "lock-protected attribute accesses outside lock scope; "
+        "cross-class lock-acquisition cycles"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        classes: Dict[str, ClassInfo] = {}
+        scanners: Dict[Tuple[str, str], _MethodScanner] = {}
+        for sf in files:
+            if not _in_scope(sf.rel) or sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = _collect_class(node, sf.rel)
+                    classes[info.name] = info
+        for info in classes.values():
+            if not info.lock_attrs:
+                continue
+            for mname, method in info.methods.items():
+                scanners[(info.name, mname)] = _scan_method(
+                    info, mname, method
+                )
+        # protected set: attrs WRITTEN under the lock (init exempt)
+        for info in classes.values():
+            for (cname, mname), sc in scanners.items():
+                if cname != info.name or mname in INIT_METHODS:
+                    continue
+                for access in sc.locked_accesses:
+                    if access.write:
+                        info.protected.add(access.attr)
+        # rule 1: protected-attr access outside lock scope
+        for (cname, mname), sc in sorted(scanners.items()):
+            info = classes[cname]
+            if mname in INIT_METHODS:
+                continue
+            seen: Set[Tuple[str, int]] = set()
+            for access in sc.unlocked_accesses:
+                if access.attr not in info.protected:
+                    continue
+                dedup = (access.attr, access.line)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                kind = "write to" if access.write else "read of"
+                yield Finding(
+                    rule="lock-discipline",
+                    path=info.rel,
+                    line=access.line,
+                    message=(
+                        f"{kind} lock-protected attribute "
+                        f"'{cname}.{access.attr}' outside lock scope in "
+                        f"'{mname}' (protected: assigned under "
+                        f"'with self.{sorted(info.lock_attrs)[0]}:')"
+                    ),
+                    symbol=access.attr,
+                )
+        yield from self._lock_order(classes, scanners)
+
+    # -- lock-order graph --------------------------------------------------
+
+    def _lock_order(
+        self,
+        classes: Dict[str, ClassInfo],
+        scanners: Dict[Tuple[str, str], _MethodScanner],
+    ) -> Iterable[Finding]:
+        locked_classes = {
+            name for name, info in classes.items() if info.lock_attrs
+        }
+
+        def resolve(cname: str, callee: str) -> Optional[Tuple[str, str]]:
+            """(class, method) a dotted callee resolves to, using the
+            class's attr/param type environment."""
+            parts = callee.split(".")
+            if parts[0] in ("self", "cls"):
+                if len(parts) == 2 and parts[1] in classes[cname].methods:
+                    return (cname, parts[1])
+                if len(parts) == 3:
+                    attr_cls = classes[cname].attr_types.get(parts[1])
+                    if attr_cls in classes and parts[2] in classes[
+                        attr_cls
+                    ].methods:
+                        return (attr_cls, parts[2])
+                return None
+            if len(parts) == 2:
+                # local var typed by annotation is out of reach here;
+                # fall back to "any in-scope class with this method
+                # whose name matches a known type of the base name"
+                base_cls = _PARAM_TYPES.get((cname, parts[0]))
+                if base_cls in classes and parts[1] in classes[
+                    base_cls
+                ].methods:
+                    return (base_cls, parts[1])
+            return None
+
+        # parameter/local type environment per class, from annotations
+        global _PARAM_TYPES
+        _PARAM_TYPES = {}
+        for cname, info in classes.items():
+            for method in info.methods.values():
+                args = getattr(method, "args", None)
+                if args is None:
+                    continue
+                for arg in list(args.args) + list(args.kwonlyargs):
+                    cls = annotation_class(arg.annotation)
+                    if cls:
+                        _PARAM_TYPES[(cname, arg.arg)] = cls
+                for sub in ast.walk(method):
+                    if isinstance(sub, ast.Assign) and len(
+                        sub.targets
+                    ) == 1 and isinstance(sub.targets[0], ast.Name):
+                        if isinstance(sub.value, ast.Call):
+                            tail = (
+                                dotted_name(sub.value.func) or ""
+                            ).split(".")[-1]
+                            if tail in classes:
+                                _PARAM_TYPES[
+                                    (cname, sub.targets[0].id)
+                                ] = tail
+                        else:
+                            src = dotted_name(sub.value)
+                            if src:
+                                sparts = src.split(".")
+                                if sparts[0] == "self" and len(sparts) == 2:
+                                    t = info.attr_types.get(sparts[1])
+                                    if t:
+                                        _PARAM_TYPES[
+                                            (cname, sub.targets[0].id)
+                                        ] = t
+                    elif isinstance(sub, ast.AnnAssign) and isinstance(
+                        sub.target, ast.Name
+                    ):
+                        cls = annotation_class(sub.annotation)
+                        if cls:
+                            _PARAM_TYPES[(cname, sub.target.id)] = cls
+
+        # acquisition summaries to a fixed point: lock classes a call
+        # to (class, method) may take internally
+        acquires: Dict[Tuple[str, str], Set[str]] = {
+            key: set() for key in scanners
+        }
+        for (cname, mname), sc in scanners.items():
+            if any(
+                a for a in sc.locked_accesses
+            ) or _takes_lock_directly(sc):
+                if not mname.endswith("_locked"):
+                    acquires[(cname, mname)].add(cname)
+        changed = True
+        while changed:
+            changed = False
+            for (cname, mname), sc in scanners.items():
+                for callee, _line, _held in sc.calls:
+                    target = resolve(cname, callee)
+                    if target is None or target not in acquires:
+                        continue
+                    add = acquires[target] - acquires[(cname, mname)]
+                    if add:
+                        acquires[(cname, mname)] |= add
+                        changed = True
+        # edges: holding A, acquire B (A != B)
+        edges: Dict[str, Set[str]] = {}
+        edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for (cname, mname), sc in scanners.items():
+            for callee, line, held in sc.calls:
+                if not held:
+                    continue
+                target = resolve(cname, callee)
+                if target is None or target not in acquires:
+                    continue
+                for acquired in acquires[target]:
+                    if acquired == cname:
+                        continue
+                    edges.setdefault(cname, set()).add(acquired)
+                    edge_sites.setdefault(
+                        (cname, acquired), (classes[cname].rel, line)
+                    )
+        # cycle detection (DFS)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {name: WHITE for name in locked_classes}
+        stack: List[str] = []
+        cycles: List[List[str]] = []
+
+        def dfs(u: str) -> None:
+            color[u] = GRAY
+            stack.append(u)
+            for v in sorted(edges.get(u, ())):
+                if v not in color:
+                    continue
+                if color[v] == GRAY:
+                    cycles.append(stack[stack.index(v):] + [v])
+                elif color[v] == WHITE:
+                    dfs(v)
+            stack.pop()
+            color[u] = BLACK
+
+        for name in sorted(locked_classes):
+            if color[name] == WHITE:
+                dfs(name)
+        for cycle in cycles:
+            first_edge = (cycle[0], cycle[1])
+            rel, line = edge_sites.get(first_edge, ("", 0))
+            yield Finding(
+                rule="lock-order",
+                path=rel or classes[cycle[0]].rel,
+                line=line,
+                message=(
+                    "lock-order inversion: acquisition cycle "
+                    + " -> ".join(cycle)
+                    + " — two threads entering from opposite ends deadlock"
+                ),
+                symbol=cycle[0],
+            )
+
+
+def _takes_lock_directly(sc: _MethodScanner) -> bool:
+    """Whether the method body contains a ``with self.<lock>:`` (even
+    with no protected accesses inside)."""
+    # locked_accesses non-empty implies yes; also detect empty-bodied
+    # acquisitions via the calls list: acquire()/wait() on a lock attr
+    for callee, _line, _held in sc.calls:
+        parts = callee.split(".")
+        if (
+            len(parts) == 3
+            and parts[0] == "self"
+            and parts[1] in sc.info.lock_attrs
+            and parts[2] in ("acquire", "wait", "wait_for")
+        ):
+            return True
+    return bool(sc.locked_accesses)
+
+
+_PARAM_TYPES: Dict[Tuple[str, str], str] = {}
+
+
+register(LockDisciplineAnalyzer())

@@ -1,0 +1,176 @@
+"""Plan-key discipline analyzer: config reads in the plan-building
+path must be covered by the plan-cache key.
+
+The bug class (fixed by hand in PR 6/7 as the "resolved pallas impl
+token" patch): ``prepare_scan`` reads a config knob, bakes its value
+into the trace, but ``_plan_cache_key`` doesn't carry it — flip the
+knob, and the cache serves a plan compiled under the OLD value. This
+analyzer closes the loop structurally:
+
+- scope: modules that define ``_plan_cache_key`` (engine/scan.py);
+- the plan-building path is every function same-module-reachable from
+  ``prepare_scan`` (bare-name and ``self.<method>`` call edges);
+- a config read is ``config.options().<attr>`` directly, or
+  ``<var>.<attr>`` where ``<var>`` was assigned from
+  ``config.options()`` in the same function;
+- the covered set is the union of attributes read inside
+  ``_plan_cache_key`` itself and the module's
+  ``PLAN_KEY_COVERED_CONFIG`` mapping (attr -> one-line justification
+  of HOW the key covers it — shape specialization, mode fork, a key
+  element). A read outside the covered set is a ``plan-key`` finding.
+
+Adding a config read to the plan path therefore forces a decision at
+lint time: thread it into the key, or document in
+``PLAN_KEY_COVERED_CONFIG`` why the key already distinguishes it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+KEY_FUNC = "_plan_cache_key"
+ROOT_FUNC = "prepare_scan"
+COVERED_CONST = "PLAN_KEY_COVERED_CONFIG"
+OPTIONS_CALLS = ("config.options", "options")
+
+
+def _is_options_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (dotted_name(node.func) or "") in OPTIONS_CALLS
+    )
+
+
+def _config_reads(func: ast.AST) -> List[Tuple[str, int]]:
+    """(attr, line) for every config-option attribute read in one
+    function: direct ``config.options().attr`` plus ``opts.attr`` for
+    locals assigned from ``config.options()``."""
+    opts_vars: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            if isinstance(node.targets[0], ast.Name) and _is_options_call(
+                node.value
+            ):
+                opts_vars.add(node.targets[0].id)
+    reads: List[Tuple[str, int]] = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Attribute):
+            continue
+        if _is_options_call(node.value):
+            reads.append((node.attr, node.lineno))
+        elif (
+            isinstance(node.value, ast.Name)
+            and node.value.id in opts_vars
+        ):
+            reads.append((node.attr, node.lineno))
+    return reads
+
+
+def _functions(tree: ast.AST) -> Dict[str, ast.AST]:
+    """method/function name -> node (flat: the plan path lives in one
+    class plus module helpers, and names don't collide in scan.py)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _callees(func: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if parts[0] in ("self", "cls") and len(parts) == 2:
+                out.add(parts[1])
+            elif len(parts) == 1:
+                out.add(parts[0])
+    return out
+
+
+def _covered_const(tree: ast.AST) -> Optional[Set[str]]:
+    """Keys of the module-level PLAN_KEY_COVERED_CONFIG mapping (or
+    elements, when it's a tuple/set), None when absent."""
+    for node in tree.body if hasattr(tree, "body") else []:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == COVERED_CONST:
+                try:
+                    literal = ast.literal_eval(value)
+                except (ValueError, SyntaxError):
+                    return None
+                if isinstance(literal, dict):
+                    return set(literal.keys())
+                return set(literal)
+    return None
+
+
+class PlanKeyAnalyzer(Analyzer):
+    name = "plankey"
+    rules = ("plan-key",)
+    description = (
+        "config reads in the prepare_scan plan-building path not "
+        "covered by _plan_cache_key / PLAN_KEY_COVERED_CONFIG"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if sf.tree is None:
+                continue
+            functions = _functions(sf.tree)
+            if KEY_FUNC not in functions or ROOT_FUNC not in functions:
+                continue
+            covered: Set[str] = set(
+                attr for attr, _ in _config_reads(functions[KEY_FUNC])
+            )
+            const = _covered_const(sf.tree)
+            if const:
+                covered |= const
+            # plan path: fixed-point reachability from prepare_scan
+            reachable: Set[str] = {ROOT_FUNC, KEY_FUNC}
+            frontier = [ROOT_FUNC, KEY_FUNC]
+            while frontier:
+                name = frontier.pop()
+                for callee in _callees(functions[name]):
+                    if callee in functions and callee not in reachable:
+                        reachable.add(callee)
+                        frontier.append(callee)
+            for name in sorted(reachable):
+                for attr, line in _config_reads(functions[name]):
+                    if attr in covered:
+                        continue
+                    yield Finding(
+                        rule="plan-key",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"config read 'options().{attr}' in plan-"
+                            f"building path '{name}' is not covered by "
+                            f"{KEY_FUNC} or {COVERED_CONST} — a cached "
+                            "plan compiled under a different value "
+                            "would be served silently"
+                        ),
+                        symbol=attr,
+                    )
+
+
+register(PlanKeyAnalyzer())

@@ -1,229 +1,43 @@
-"""Lint: hot-path modules must not roll their own timing/tracing —
-or their own out-of-memory classification, or their own device syncs.
+"""Compat shim: the telemetry/OOM/sync/service token rules now live in
+``tools/staticcheck`` (docs/STATIC_ANALYSIS.md) — one framework, one
+waiver syntax, one gate.
 
-All wall-clock attribution lives in ``deequ_tpu/telemetry/`` (spans,
-PhaseClock, pass timing) so trace names stay consistent with XProf and
-timings stay comparable across PRs. This tool tokenizes every module
-under the hot-path packages and flags ``time.perf_counter``,
-``jax.profiler.start_trace``/``stop_trace``, and ``TraceAnnotation``
-references outside the telemetry layer.
+This module keeps the historical surface alive unchanged:
 
-Likewise, all memory-pressure classification lives in
-``deequ_tpu/engine/memory.py`` (classify_memory_pressure): an ad-hoc
-``except MemoryError`` or a bare OOM marker string
-(``RESOURCE_EXHAUSTED`` / "out of memory") anywhere else in the hot
-path would fork the taxonomy — flagged the same way.
+- ``find_violations(root)`` returns the same ``(relpath, line, token)``
+  tuples tests and scripts have always consumed, now rebuilt from the
+  framework's findings for the five migrated rule families
+  (``telemetry-timing``, ``oom-taxonomy``, ``sync-discipline``,
+  ``service-time``, ``service-admission`` — plus ``tokenize-error``,
+  which restores the old ``(rel, 0, "<tokenize error>")`` tuple that a
+  typo'd ``except tokenize.TokenizeError`` clause had turned into an
+  AttributeError: the real exception is ``tokenize.TokenError``).
+- ``python -m tools.telemetry_lint [root]`` still prints one line per
+  violation and exits non-zero when any exist.
 
-Sync discipline (the r6 rule): inside ``deequ_tpu/engine/`` the ONE
-sanctioned host<->device fetch is the packed epilogue
-(``engine/pack.py`` ``packed_device_get``) — a stray ``device_get`` or
-``asarray`` in a scan hot loop is a per-batch tunnel round trip, the
-exact regression class the 2-syncs-per-profile pin exists to prevent
-(tests/test_sync_discipline.py). ``device_get``/``asarray`` NAME
-tokens in engine modules outside pack.py are flagged unless the line
-carries an inline ``# sync-ok: <reason>`` waiver documenting why the
-call is host-side or a deliberate, clock-attributed sync (checkpoint
-drain, mesh epilogue).
-
-Service discipline (PR 7): modules under ``deequ_tpu/service/`` may
-not read or burn wall time themselves (``time.time``/``time.sleep``/
-``monotonic``/``perf_counter``) — every scheduling decision rides the
-injectable clocks from ``engine/deadline.py`` so the whole scheduler
-is assertable on fake time — and may not bypass the runner's admission
-layer by referencing the engine scan entry points (``run_scan``,
-``prepare_scan``, ``execute_plan``). Run from the test suite
-(tests/test_telemetry.py) and by hand:
-
-    python -m tools.telemetry_lint [repo_root]
+New callers should use ``python -m tools.staticcheck`` directly; it
+runs these rules AND the AST analyzers (locks, interrupts, trace,
+plan-key) behind the same ``# lint-ok:`` waiver syntax.
 """
 
 from __future__ import annotations
 
-import io
 import os
 import sys
-import tokenize
 from typing import List, Optional, Tuple
 
-# packages whose modules the fused-scan / verification flow executes;
-# utils is included (observe.py is a pure adapter now)
-HOT_PATH_DIRS = (
-    "deequ_tpu/engine",
-    "deequ_tpu/data",
-    "deequ_tpu/analyzers",
-    "deequ_tpu/profiles",
-    "deequ_tpu/verification",
-    "deequ_tpu/sketches",
-    "deequ_tpu/checks",
-    "deequ_tpu/io",
-    "deequ_tpu/utils",
-    "deequ_tpu/service",
-)
+from tools.staticcheck import run_analyzers, unwaived
+from tools.staticcheck.tokens import TokenDisciplineAnalyzer
 
-# NAME tokens that mean "module does its own timing/tracing"
-FORBIDDEN_NAMES = frozenset(
-    {"perf_counter", "start_trace", "stop_trace", "TraceAnnotation"}
-)
-
-# the one place allowed to touch clocks and the profiler
-EXEMPT_PREFIX = "deequ_tpu/telemetry/"
-
-# NAME tokens that mean "module rolls its own OOM taxonomy" (the
-# MemoryPressureError family + classify_memory_pressure are fine —
-# different token)
-FORBIDDEN_OOM_NAMES = frozenset({"MemoryError"})
-
-# STRING-literal markers that mean "module string-matches allocator
-# failures itself" (lowercased containment check)
-FORBIDDEN_OOM_MARKERS = ("resource_exhausted", "out of memory")
-
-# the one classification point (engine/memory.py docstring)
-OOM_EXEMPT_FILES = frozenset({"deequ_tpu/engine/memory.py"})
-
-# NAME tokens that mean "module syncs with the device on its own"
-# inside the engine layer; every legitimate use is either in pack.py
-# (the packed epilogue) or carries a same-line `# sync-ok:` waiver
-FORBIDDEN_SYNC_NAMES = frozenset({"device_get", "asarray"})
-SYNC_HOT_PREFIX = "deequ_tpu/engine/"
-SYNC_EXEMPT_FILES = frozenset({"deequ_tpu/engine/pack.py"})
-SYNC_WAIVER_MARKER = "sync-ok:"
-
-# the service layer (deequ_tpu/service/, docs/SERVICE.md) runs on
-# INJECTED clocks only — the engine/deadline.py discipline that makes
-# every scheduling behavior assertable on fake time — and must enter
-# execution through the runner's admission layer, never the engine
-# directly. Two rule families:
-# - direct time: bare ``sleep``/``monotonic``/``perf_counter`` NAME
-#   tokens, plus the ``time.<attr>`` attribute chain (``time.time`` is
-#   caught by sequence, not by banning the ubiquitous NAME "time")
-# - admission bypass: any reference to the engine's scan entry points
-SERVICE_PREFIX = "deequ_tpu/service/"
-SERVICE_FORBIDDEN_NAMES = frozenset(
-    {
-        "sleep",
-        "monotonic",
-        "run_scan",
-        "prepare_scan",
-        "execute_plan",
-        "_run_scan_resident",
-        "_run_scan_streaming",
-    }
-)
-SERVICE_TIME_ATTRS = frozenset(
-    {"time", "sleep", "monotonic", "perf_counter"}
-)
+#: the migrated rule families this shim reports on
+TOKEN_RULES: Tuple[str, ...] = TokenDisciplineAnalyzer.rules
 
 
 def find_violations(root: str) -> List[Tuple[str, int, str]]:
-    """(relpath, line, token) for every forbidden NAME token in a
-    hot-path module — own-timing names everywhere outside the telemetry
-    layer, ad-hoc OOM classification (``MemoryError`` NAME tokens, OOM
-    marker STRING literals) outside engine/memory.py, and engine-layer
-    device syncs (``device_get``/``asarray``) outside pack.py without a
-    same-line ``# sync-ok:`` waiver. Tokenize-based: a mention in a
-    comment or docstring does not flag; an aliased import (``from time
-    import perf_counter``) does."""
-    violations: List[Tuple[str, int, str]] = []
-    for rel_dir in HOT_PATH_DIRS:
-        top = os.path.join(root, rel_dir)
-        if not os.path.isdir(top):
-            continue
-        for dirpath, _dirnames, filenames in os.walk(top):
-            for filename in sorted(filenames):
-                if not filename.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, filename)
-                rel = os.path.relpath(path, root).replace(os.sep, "/")
-                if rel.startswith(EXEMPT_PREFIX):
-                    continue
-                oom_exempt = rel in OOM_EXEMPT_FILES
-                sync_checked = rel.startswith(
-                    SYNC_HOT_PREFIX
-                ) and rel not in SYNC_EXEMPT_FILES
-                service_checked = rel.startswith(SERVICE_PREFIX)
-                with open(path, "rb") as fh:
-                    source = fh.read()
-                try:
-                    tokens = list(
-                        tokenize.tokenize(io.BytesIO(source).readline)
-                    )
-                except tokenize.TokenizeError:
-                    violations.append((rel, 0, "<tokenize error>"))
-                    continue
-                # lines waived for the sync rule by an inline comment
-                waived = {
-                    tok.start[0]
-                    for tok in tokens
-                    if tok.type == tokenize.COMMENT
-                    and SYNC_WAIVER_MARKER in tok.string
-                }
-                for tok in tokens:
-                    if tok.type == tokenize.NAME and (
-                        tok.string in FORBIDDEN_NAMES
-                        or (
-                            not oom_exempt
-                            and tok.string in FORBIDDEN_OOM_NAMES
-                        )
-                    ):
-                        violations.append(
-                            (rel, tok.start[0], tok.string)
-                        )
-                    elif (
-                        tok.type == tokenize.NAME
-                        and sync_checked
-                        and tok.string in FORBIDDEN_SYNC_NAMES
-                        and tok.start[0] not in waived
-                    ):
-                        violations.append(
-                            (rel, tok.start[0], tok.string)
-                        )
-                    elif (
-                        tok.type == tokenize.STRING
-                        and not oom_exempt
-                        and any(
-                            marker in tok.string.lower()
-                            for marker in FORBIDDEN_OOM_MARKERS
-                        )
-                    ):
-                        violations.append(
-                            (rel, tok.start[0], "<oom marker string>")
-                        )
-                if service_checked:
-                    violations.extend(
-                        (rel, line, name)
-                        for line, name in _service_violations(tokens)
-                    )
-    return violations
-
-
-def _service_violations(tokens) -> List[Tuple[int, str]]:
-    """Service-layer rules on one module's token stream: banned NAME
-    tokens (own sleeps/clocks, engine scan entry points) plus the
-    ``time.<attr>`` attribute-chain check for ``time.time`` (sequence
-    over significant tokens, so comments/docstrings never flag)."""
-    out: List[Tuple[int, str]] = []
-    significant = [
-        tok
-        for tok in tokens
-        if tok.type
-        in (tokenize.NAME, tokenize.OP, tokenize.NUMBER, tokenize.STRING)
-    ]
-    for i, tok in enumerate(significant):
-        if tok.type != tokenize.NAME:
-            continue
-        if tok.string in SERVICE_FORBIDDEN_NAMES:
-            out.append((tok.start[0], tok.string))
-        elif (
-            tok.string == "time"
-            and i + 2 < len(significant)
-            and significant[i + 1].string == "."
-            and significant[i + 2].type == tokenize.NAME
-            and significant[i + 2].string in SERVICE_TIME_ATTRS
-        ):
-            out.append(
-                (tok.start[0], f"time.{significant[i + 2].string}")
-            )
-    return out
+    """(relpath, line, token) for every unwaived token-rule finding —
+    the historical tuple API, served by the staticcheck framework."""
+    findings = unwaived(run_analyzers(root, rules=list(TOKEN_RULES)))
+    return [(f.path, f.line, f.symbol) for f in findings]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -239,10 +53,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{len(violations)} violation(s): timing/tracing belongs in "
             "the telemetry layer (docs/OBSERVABILITY.md); engine syncs "
             "belong in the packed epilogue (engine/pack.py) or need a "
-            "'# sync-ok:' waiver"
+            "'# sync-ok:' waiver. Full suite: python -m tools.staticcheck"
         )
         return 1
-    print("telemetry lint clean")
+    print("telemetry lint clean (via tools.staticcheck)")
     return 0
 
 
