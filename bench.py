@@ -593,6 +593,133 @@ def bench_streaming_parquet(num_rows: int, num_cols: int):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_streaming_wire_diet(num_rows: int = 4_000_000):
+    """Wire-diet config (docs/PERF.md): the SAME multi-file parquet
+    table streamed twice — per-column codecs + one-pass dictionary
+    deltas ON vs OFF — so the bytes/row reduction and the put/compute
+    overlap of the depth-2 pipeline are measured differentially on
+    identical data. The table is codec-friendly on purpose: int64 keys
+    whose stats admit i16/i32, f64 measures that are f32-exact, and
+    dictionary strings (codes + deltas instead of a value pre-pass)."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        ApproxCountDistinct,
+        DataType,
+        Maximum,
+        Mean,
+        Minimum,
+    )
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.telemetry import get_telemetry
+
+    rng = np.random.default_rng(17)
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_wire_")
+    # the string suite pairs ACD + DataType on BOTH columns so the
+    # codes ride one pooled unit: deltas on = ONE traversal of the
+    # source; deltas off re-reads each column once for its value_set
+    # (data_passes 1 vs 3 in the artifact)
+    analyzers = [
+        Mean("f0"), Minimum("f0"), Maximum("f0"), Mean("f1"),
+        Minimum("k0"), Maximum("k0"), ApproxCountDistinct("k1"),
+        ApproxCountDistinct("k2"),
+        ApproxCountDistinct("s0"), ApproxCountDistinct("s1"),
+        DataType("s0"), DataType("s1"),
+    ]
+    try:
+        shard_rows = num_rows // 4
+        cats = np.array([f"cat_{j:04d}" for j in range(512)])
+        for i in range(4):
+            rows = num_rows - 3 * shard_rows if i == 3 else shard_rows
+            # f32-exact doubles: generate as f32, store as f64
+            f = rng.normal(100.0, 25.0, rows).astype(np.float32)
+            pq.write_table(
+                pa.table(
+                    {
+                        "f0": pa.array(f.astype(np.float64)),
+                        "f1": pa.array(
+                            np.abs(f).astype(np.float64)
+                        ),
+                        "k0": pa.array(
+                            rng.integers(0, 30_000, rows, dtype=np.int64)
+                        ),
+                        "k1": pa.array(
+                            rng.integers(0, 100, rows, dtype=np.int64)
+                        ),
+                        "k2": pa.array(
+                            rng.integers(0, 2, rows, dtype=np.int64)
+                        ),
+                        "s0": pa.array(
+                            cats[rng.integers(0, len(cats), rows)]
+                        ),
+                        "s1": pa.array(
+                            cats[rng.integers(0, 64, rows)]
+                        ),
+                    }
+                ),
+                f"{workdir}/part{i}.parquet",
+            )
+
+        tm = get_telemetry()
+
+        def run(codecs_on: bool):
+            with config.configure(
+                device_cache_bytes=0,
+                batch_size=1 << 19,
+                wire_codecs=codecs_on,
+                dict_deltas=codecs_on,
+            ):
+                AnalysisRunner.do_analysis_run(  # warm the plan
+                    Dataset.from_parquet(workdir), analyzers
+                )
+                raw0 = tm.counter("engine.wire_bytes_raw").value
+                enc0 = tm.counter("engine.wire_bytes_encoded").value
+                passes0 = tm.counter("engine.data_passes").value
+                wall, shipped, mbps, ctx = _timed(
+                    lambda: AnalysisRunner.do_analysis_run(
+                        Dataset.from_parquet(workdir), analyzers
+                    )
+                )
+                return {
+                    "wall_s": wall,
+                    "rows_per_sec": num_rows / wall,
+                    "bytes_shipped": shipped,
+                    "link_mb_per_sec": mbps,
+                    "raw_bytes_per_row": (
+                        tm.counter("engine.wire_bytes_raw").value - raw0
+                    ) / num_rows,
+                    "encoded_bytes_per_row": (
+                        tm.counter("engine.wire_bytes_encoded").value
+                        - enc0
+                    ) / num_rows,
+                    "data_passes": (
+                        tm.counter("engine.data_passes").value - passes0
+                    ),
+                    "phases": _phases(ctx.run_metadata),
+                }
+
+        on = run(True)
+        off = run(False)
+        return {
+            "codecs_on": on,
+            "codecs_off": off,
+            "bytes_per_row_reduction": (
+                off["encoded_bytes_per_row"] / on["encoded_bytes_per_row"]
+                if on["encoded_bytes_per_row"] > 0
+                else 0.0
+            ),
+            "wall_speedup": off["wall_s"] / on["wall_s"],
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_resilience_overhead(num_rows: int = 4_000_000):
     """Resilience tax on a CLEAN scan (docs/RESILIENCE.md): the same
     streaming fused-bundle run with retry + periodic checkpointing ON
@@ -1200,6 +1327,11 @@ def main(argv=None):
              # link), not the 8s a healthy link delivers — gating on
              # the median is how r05 overran its budget
              lambda: bench_streaming_parquet(4_000_000, 10), 390),
+            ("streaming_wire_diet",
+             # two streamed passes over the same 4M-row table (codecs
+             # on, then off); budget sized like streaming_parquet's
+             # worst observed link, not its healthy-link median
+             lambda: bench_streaming_wire_diet(4_000_000), 390),
             ("streaming_bundle_100m",
              lambda: bench_streaming_bundle_100m(), 330),
         ]

@@ -163,6 +163,19 @@ class _CacheTokenAuto:
 CACHE_TOKEN_AUTO = _CacheTokenAuto()
 
 
+class _DeltaPrime:
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return "<DELTA_PRIME>"
+
+
+# Sentinel passed to ScanOps.host_delta on NON-streaming paths (resident
+# scan, sharded step) where no per-batch dictionary deltas flow: the op
+# must load the FULL dictionaries from the dataset (a pre-pass is fine
+# there — the data is already resident). Without priming, a delta-aware
+# op's LUT state would silently stay empty on those paths.
+DELTA_PRIME = _DeltaPrime()
+
+
 @dataclass
 class ScanOps:
     """The (identity, update, merge) triple for one analyzer, compiled
@@ -202,6 +215,16 @@ class ScanOps:
     # engine excludes it from the epilogue's packed fetch instead of
     # round-tripping megabytes of keys through the host.
     device_result: bool = False
+    # one-pass dictionary deltas (docs/PERF.md "Wire diet"): ops whose
+    # LUTs live in STATE instead of consts receive incremental
+    # dictionary updates here. Called on the host as
+    # ``host_delta(state, deltas)`` where ``deltas`` maps column ->
+    # {"start": int, "values": ndarray} (new uniques appended at
+    # ``start``), or with the DELTA_PRIME sentinel on non-streaming
+    # paths (load full dictionaries from the dataset). Returns the
+    # updated state tree; applied in batch order BEFORE that batch's
+    # fused update so codes never index past the shipped LUT rows.
+    host_delta: Optional[Callable[[StateTree, Any], StateTree]] = None
 
     def apply_update(self, state, batch, consts):
         if self.consts is None:

@@ -87,6 +87,33 @@ class Options:
     hll_dedup_widening: bool = (
         os.environ.get("DEEQU_TPU_HLL_DEDUP_WIDENING", "1") != "0"
     )
+    # per-column wire codecs on the streamed packed wire
+    # (engine/wire.py, docs/PERF.md "Wire diet"): each column's wire
+    # dtype is resolved ONCE per run from parquet statistics or a
+    # first-batch probe (int64 -> i32/i16/i8 by range, f64 -> f32 when
+    # values provably round-trip bit-exactly, codes/lengths by observed
+    # magnitude) and decoded back to the canonical dtype inside the
+    # fused wire_unpack, so device programs are bit-identical either
+    # way. False ships today's canonical-width wire — kept as the
+    # differential oracle (tests/test_wire_codecs.py)
+    wire_codecs: bool = (
+        os.environ.get("DEEQU_TPU_WIRE_CODECS", "1") != "0"
+    )
+    # one-pass dictionary deltas for streamed string codes
+    # (data/parquet.py, engine/vectorize.py): dictionaries build
+    # incrementally per batch and ship only the NEW uniques (delta
+    # payloads applied to LUT-carrying op states), killing the
+    # _dict_value_set streaming pre-pass — string-code suites traverse
+    # the source exactly once. False restores the pre-pass path
+    dict_deltas: bool = (
+        os.environ.get("DEEQU_TPU_DICT_DELTAS", "1") != "0"
+    )
+    # static LUT capacity (entries) carried by delta-aware op states; a
+    # dictionary growing past it is a deterministic error (raise the
+    # cap or set dict_deltas=False for that source)
+    dict_delta_capacity: int = int(
+        os.environ.get("DEEQU_TPU_DICT_DELTA_CAPACITY", 1 << 16)
+    )
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

@@ -11,9 +11,18 @@ the same Dataset contract over a (multi-file) parquet source:
   converted to device representations per batch, and fed to the fused
   scan — host memory stays O(batch x requested columns), so a table
   far larger than RAM profiles fine.
-- string columns get a GLOBAL dictionary built in one streaming
-  pre-pass (O(distinct) memory) so code-based LUT closures (PatternMatch,
-  predicates, HLL) see stable codes across batches.
+- string columns: under ``config.dict_deltas`` (default) the global
+  dictionary is built INCREMENTALLY inside the same pass — each batch
+  absorbs its new uniques into a per-column accumulator, codes index
+  against the accumulator, and only the DELTA (new uniques, appended
+  in first-occurrence order) rides the batch as a host-only payload
+  (table.DICT_DELTA_PREFIX) for delta-aware ops to fold into their
+  LUT states. Incremental accumulation provably reproduces the exact
+  dictionary (values AND order) of the legacy streaming pre-pass
+  (``_collect_uniques``), independent of chunking — which is why
+  delta codes and pre-pass codes are interchangeable and a stable
+  dictionary costs zero bytes after batch 1. With the flag off, the
+  legacy one-extra-pass pre-pass builds the dictionary up front.
 - ``materialize`` (full column) still works — the resident fast path
   uses it when the request set fits the device cache budget — but the
   streaming path never calls it.
@@ -30,6 +39,7 @@ import pyarrow.dataset as pads
 
 from deequ_tpu.data.table import (
     ColumnRequest,
+    DICT_DELTA_PREFIX,
     Dataset,
     Field,
     Kind,
@@ -40,6 +50,50 @@ from deequ_tpu.data.table import (
     dictionary_to_numpy,
     narrow_codes,
 )
+
+
+class _IncrementalDict:
+    """One column's global dictionary, grown batch-by-batch inside the
+    single data pass. ``absorb_and_encode`` appends a batch's new
+    uniques (first-occurrence order — provably the same dictionary,
+    values and order, that ``_collect_uniques`` builds over the same
+    row stream, whatever the chunking) and returns the batch's int32
+    codes against the grown accumulator, so a row's code is always
+    valid against every dictionary state at or after its batch."""
+
+    __slots__ = ("values", "n")
+
+    def __init__(self) -> None:
+        self.values: Optional[pa.Array] = None
+        self.n = 0
+
+    def absorb_and_encode(self, column: pa.Array) -> np.ndarray:
+        if pa.types.is_dictionary(column.type):
+            column = pc.cast(column, column.type.value_type)
+        u = pc.drop_null(pc.unique(column))
+        if len(u):
+            if self.values is None:
+                self.values = u
+            else:
+                idx = pc.index_in(u, value_set=self.values)
+                new = u.filter(pc.is_null(idx))
+                if len(new):
+                    self.values = pa.concat_arrays([self.values, new])
+            self.n = len(self.values)
+        if self.values is None or self.n == 0:
+            return np.full(len(column), -1, dtype=np.int32)
+        idx = pc.index_in(column, value_set=self.values)
+        idx = pc.fill_null(idx, pa.scalar(-1, idx.type))
+        return np.ascontiguousarray(
+            idx.to_numpy(zero_copy_only=False).astype(np.int32)
+        )
+
+    def slice_values(self, start: int) -> np.ndarray:
+        """Accumulated values [start, n) as a host numpy array — one
+        delta payload's ``values``."""
+        return dictionary_to_numpy(
+            self.values.slice(start, self.n - start)
+        )
 
 
 def _column_batch_to_reprs(
@@ -67,6 +121,9 @@ def _column_batch_to_reprs(
             idx = pc.index_in(column, value_set=value_set)
             idx = pc.fill_null(idx, pa.scalar(-1, idx.type))
             out["codes"] = np.ascontiguousarray(
+                # lint-ok: wire-discipline: loop is over the REPRS of
+                # one column, not batches; the width derives from the
+                # run-stable global value_set, identical every batch
                 narrow_codes(
                     idx.to_numpy(zero_copy_only=False).astype(np.int32),
                     len(value_set),
@@ -98,6 +155,13 @@ class ParquetDataset(Dataset):
         self._num_rows = self._source.count_rows()
         self._materialized: Dict[str, np.ndarray] = {}
         self._dictionaries: Dict[str, np.ndarray] = {}
+        # one-pass dictionary deltas: per-column incremental
+        # accumulators (persist across device_batches calls so a
+        # restart resumes the grown dictionary) and the set of columns
+        # COMMITTED to delta mode by a plan-time dict_delta_capacity
+        # consultation
+        self._delta_dicts: Dict[str, _IncrementalDict] = {}
+        self._delta_columns: set = set()
         self._value_sets: Dict[str, pa.Array] = {}
         self._null_counts: Dict[str, int] = {}
         self._device_cache: Dict = {}
@@ -235,6 +299,12 @@ class ParquetDataset(Dataset):
         conversion/narrowing rules is reflected here automatically."""
         if req.repr == "mask":
             return np.dtype(bool)
+        if req.repr == "codes" and self._dict_delta_mode(req.column):
+            # delta-mode codes are canonical int32 on every path (the
+            # wire codec layer narrows them on the wire); crucially
+            # this answers WITHOUT the dictionary pre-pass — plan
+            # building must stay zero-pass
+            return np.dtype(np.int32)
         kind = self._schema.kind_of(req.column)
         value_set = (
             self._dict_value_set(req.column)
@@ -252,6 +322,43 @@ class ParquetDataset(Dataset):
         )
         return np.dtype(out[req.repr].dtype)
 
+    # -- one-pass dictionary deltas --------------------------------------
+
+    def _dict_delta_mode(self, column: str) -> bool:
+        """True when this column's codes ship as incremental dictionary
+        deltas inside the single data pass (docs/PERF.md "One-pass
+        dictionary deltas") instead of via the legacy pre-pass. A
+        column COMMITTED by ``dict_delta_capacity`` stays in delta mode
+        for run-long consistency; otherwise the flag and the kind
+        decide — except when an already-cached dictionary is too big
+        for the delta LUT capacity, where the free consts path wins."""
+        if column in self._delta_columns:
+            return True
+        from deequ_tpu import config
+
+        opts = config.options()
+        if not opts.dict_deltas:
+            return False
+        if self._schema.kind_of(column) != Kind.STRING:
+            return False
+        d = self._dictionaries.get(column)
+        if d is not None and len(d) > opts.dict_delta_capacity:
+            return False
+        return True
+
+    def dict_delta_capacity(self, column: str) -> Optional[int]:
+        """Static delta-LUT capacity for delta-aware consumers at PLAN
+        time (None: this column's codes will not ship deltas — build
+        the consts-LUT form). Consulting this COMMITS the column: once
+        a plan holds a delta-aware op sized to the capacity,
+        ``device_batches`` must ship deltas for it on every call."""
+        if not self._dict_delta_mode(column):
+            return None
+        self._delta_columns.add(column)
+        from deequ_tpu import config
+
+        return int(config.options().dict_delta_capacity)
+
     # -- global dictionaries (streaming pre-pass) -----------------------
 
     def _collect_uniques(
@@ -261,6 +368,12 @@ class ParquetDataset(Dataset):
         (pc.unique per chunk, periodic compaction) — a Python set would
         cost GBs at tens of millions of distinct values. Returns None
         once the count provably exceeds ``cap``."""
+        # an HONEST pass counter: this pre-pass reads the whole column,
+        # so one-pass claims (tests/test_wire_codecs.py) can pin that
+        # delta-mode suites never reach here
+        from deequ_tpu.telemetry import get_telemetry
+
+        get_telemetry().counter("engine.data_passes").inc()
         base: Optional[pa.Array] = None  # already-deduped accumulator
         fresh: List[pa.Array] = []  # per-batch uniques since last compact
         fresh_n = 0
@@ -389,6 +502,11 @@ class ParquetDataset(Dataset):
                     value_set,
                     values_dtype,
                 )[r]
+            if r == "codes" and self._dict_delta_mode(req.column):
+                # delta-committed codes are canonical int32 on EVERY
+                # path, so resident and streaming plans of the same
+                # suite see one dtype (request_dtype above agrees)
+                arr = arr.astype(np.int32)
             self._materialized[f"{req.column}::{r}"] = arr
         return self._materialized[key]
 
@@ -428,11 +546,29 @@ class ParquetDataset(Dataset):
                 keys, batch_size, n, skip_rows
             )
             return
-        # pre-build dictionaries for code requests (streaming pre-pass)
+        # one-pass dictionary deltas: delta-mode columns build their
+        # dictionary INSIDE this pass and ship only deltas; everything
+        # else keeps the legacy streaming pre-pass
+        delta_cols = sorted(
+            c
+            for c, reprs in by_column.items()
+            if "codes" in reprs and self._dict_delta_mode(c)
+        )
+        accs = {
+            c: self._delta_dicts.setdefault(c, _IncrementalDict())
+            for c in delta_cols
+        }
+        # per-CALL delta cursors: a fresh call (restart or resume)
+        # re-ships the full accumulated dictionary on its first yielded
+        # batch — idempotent by construction, since delta application
+        # overwrites LUT rows with values hashed/classified from the
+        # values themselves
+        shipped_n = {c: 0 for c in delta_cols}
+        # pre-build dictionaries for remaining code requests
         value_sets = {
             c: self._dict_value_set(c)
             for c, reprs in by_column.items()
-            if "codes" in reprs
+            if "codes" in reprs and c not in accs
         }
         values_dtypes = {
             c: self._values_dtype(c)
@@ -471,6 +607,18 @@ class ParquetDataset(Dataset):
                         if k.endswith("::mask"):
                             batch[k] = batch[k] & row_mask
                 batch[ROW_MASK] = row_mask
+                # attach pending dictionary deltas to the FIRST batch
+                # drained since the accumulator grew: every code in
+                # this (and any earlier) batch indexes within the
+                # shipped rows by construction
+                for c in delta_cols:
+                    acc = accs[c]
+                    if acc.n > shipped_n[c]:
+                        batch[DICT_DELTA_PREFIX + c] = {
+                            "start": shipped_n[c],
+                            "values": acc.slice_values(shipped_n[c]),
+                        }
+                        shipped_n[c] = acc.n
                 pending_rows -= width
                 yield batch
 
@@ -488,18 +636,43 @@ class ParquetDataset(Dataset):
                 continue
             for ci, column_name in enumerate(columns):
                 kind = self._schema.kind_of(column_name)
-                reprs = _column_batch_to_reprs(
-                    record_batch.column(ci),
-                    kind,
-                    by_column[column_name],
-                    value_sets.get(column_name),
-                    values_dtypes.get(column_name),
-                )
+                wanted = by_column[column_name]
+                col = record_batch.column(ci)
+                if column_name in accs:
+                    reprs = _column_batch_to_reprs(
+                        col,
+                        kind,
+                        [r for r in wanted if r != "codes"],
+                    )
+                    # absorb new uniques + encode against the grown
+                    # accumulator — the one traversal of the values
+                    reprs["codes"] = accs[
+                        column_name
+                    ].absorb_and_encode(col)
+                else:
+                    reprs = _column_batch_to_reprs(
+                        col,
+                        kind,
+                        wanted,
+                        value_sets.get(column_name),
+                        values_dtypes.get(column_name),
+                    )
                 for repr_name, arr in reprs.items():
                     pending[f"{column_name}::{repr_name}"].append(arr)
             pending_rows += record_batch.num_rows
             yield from drain(force_pad=False)
         yield from drain(force_pad=True)
+        if start_batch == 0:
+            # a full uninterrupted stream saw every record batch, so
+            # the accumulator IS the global dictionary — cache it and a
+            # later resident pass / profiler / single-analyzer consumer
+            # pays no extra data pass
+            for c in delta_cols:
+                if (
+                    c not in self._dictionaries
+                    and accs[c].values is not None
+                ):
+                    self._store_dictionary(c, accs[c].values)
 
     def _empty_or_counting_batches(
         self, keys, batch_size: int, n: int, skip_rows: int = 0
@@ -510,6 +683,10 @@ class ParquetDataset(Dataset):
                 return
             batch: Dict[str, np.ndarray] = {}
             for k, r in keys.items():
+                if r.repr == "codes" and self._dict_delta_mode(r.column):
+                    # delta-mode codes: canonical int32, no pre-pass
+                    batch[k] = np.zeros((batch_size,), dtype=np.int32)
+                    continue
                 kind = self._schema.kind_of(r.column)
                 value_set = (
                     self._dict_value_set(r.column)

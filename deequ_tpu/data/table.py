@@ -36,6 +36,13 @@ import pyarrow.compute as pc
 
 ROW_MASK = "__row_mask__"
 
+# Host-only dictionary-delta payloads riding a streamed batch dict
+# (data/parquet.py produces them, the engine's streaming loop pops them
+# before transfer and applies them to LUT-carrying op states): key
+# ``DICT_DELTA_PREFIX + column`` -> {"start": int, "values": ndarray}.
+# Never part of the wire layout, never device_put.
+DICT_DELTA_PREFIX = "__dict_delta__:"
+
 # -- host->device transfer accounting (monotonic; bench snapshots it) ----
 # The tally lives on the telemetry registry now (counter
 # "transfer.bytes" — always on, docs/OBSERVABILITY.md); these module
@@ -73,6 +80,8 @@ def _chunk_row_mask_fn(chunk_nb: int, batch_size: int):
         off = jax.lax.broadcasted_iota(jnp.int64, (chunk_nb, batch_size), 1)
         return start + idx * batch_size + off < n
 
+    # lint-ok: wire-discipline: resident-path device helper — the row
+    # mask is BUILT on device (no wire transfer), not placed from host
     return jax.jit(build)
 
 
@@ -91,6 +100,8 @@ def _unpack_mask_bits(packed, batch_size: int):
 def _mask_unpack_fn(batch_size: int):
     import jax
 
+    # lint-ok: wire-discipline: the device-side half of the 1-bit/row
+    # mask wire itself; the engine composes it into the fused unpack
     return jax.jit(
         functools.partial(_unpack_mask_bits, batch_size=batch_size)
     )
@@ -110,6 +121,8 @@ def _lengths_gather_fn():
         idx = codes.astype(jnp.int32) + 1
         return jnp.take(lut, jnp.clip(idx, 0, lut.shape[0] - 1), axis=0)
 
+    # lint-ok: wire-discipline: wire-FREE lengths — the LUT gather
+    # replaces a 4-bytes/row transfer, it does not add one
     return jax.jit(gather)
 
 
@@ -868,7 +881,10 @@ class Dataset:
         def put(host: np.ndarray):
             add_transfer_bytes(host.nbytes)
             if sharding is not None:
+                # lint-ok: wire-discipline: the chunk-cache put IS the
+                # resident wire (packed chunks, transfer accounted)
                 return jax.device_put(host, sharding)
+            # lint-ok: wire-discipline: resident wire put (see above)
             return jax.device_put(host)
 
         keys = self._dedup_requests(requests)

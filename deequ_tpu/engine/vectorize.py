@@ -99,6 +99,43 @@ def _stack_luts(luts: List[np.ndarray], fill=0) -> np.ndarray:
     )
 
 
+def _delta_cap_of(dataset: Dataset, columns: List[str]) -> Optional[int]:
+    """The group's delta-LUT capacity when EVERY member column ships
+    one-pass dictionary deltas (data/parquet.py dict_delta_capacity),
+    else None — the builders then keep the consts-LUT form. Consulting
+    the dataset COMMITS the columns to delta mode, so the decision here
+    and the dataset's device_batches behavior can never diverge."""
+    cap_fn = getattr(dataset, "dict_delta_capacity", None)
+    if cap_fn is None:
+        return None
+    caps = [cap_fn(c) for c in columns]
+    if not caps or any(cap is None for cap in caps):
+        return None
+    return int(max(caps))
+
+
+def _set_lut_row(lut, i: int, row: np.ndarray):
+    """Overwrite row ``i`` of a (C, L) LUT state leaf, host numpy or
+    device array alike (host_delta runs outside jit: numpy before the
+    first dispatch, a committed device array after)."""
+    if isinstance(lut, np.ndarray):
+        out = lut.copy()
+        out[i, :] = row
+        return out
+    # lint-ok: sync-discipline: converts the HOST numpy mirror row for
+    # a .at[].set update; no device fetch happens here
+    return lut.at[i].set(np.asarray(row))
+
+
+def _delta_overflow(column: str, needed: int, cap: int) -> RuntimeError:
+    return RuntimeError(
+        f"dictionary for column {column!r} grew to {needed} values, "
+        f"past dict_delta_capacity={cap}: raise "
+        "DEEQU_TPU_DICT_DELTA_CAPACITY or set dict_deltas=False to "
+        "fall back to the pre-pass consts path"
+    )
+
+
 # --------------------------------------------------------------------------
 # shared per-batch prologue (cross-unit stack/sort memoization)
 # --------------------------------------------------------------------------
@@ -417,27 +454,47 @@ def _build_hll_group(
     C = len(columns)
 
     consts = None
+    host_delta = None
+    delta_cap = None
     if value_repr == "codes":
-        luts1, luts2 = [], []
-        for c in columns:
-            h1, h2 = hll.dictionary_hash_pairs(dataset.dictionary(c))
-            luts1.append(h1)
-            luts2.append(h2)
-        consts = {"h1": _stack_luts(luts1), "h2": _stack_luts(luts2)}
+        # one-pass dictionary deltas: when every member column ships
+        # deltas, the hash LUTs move from consts into STATE at a fixed
+        # (C, cap) shape and host_delta folds each delta's hash pairs
+        # in as it arrives — no dictionary pre-pass at build time
+        delta_cap = _delta_cap_of(dataset, columns)
+        if delta_cap is None:
+            luts1, luts2 = [], []
+            for c in columns:
+                h1, h2 = hll.dictionary_hash_pairs(dataset.dictionary(c))
+                luts1.append(h1)
+                luts2.append(h2)
+            consts = {"h1": _stack_luts(luts1), "h2": _stack_luts(luts2)}
 
     def init():
+        if delta_cap is not None:
+            return {
+                "registers": np.zeros((C, hll.M), dtype=np.int8),
+                "h1": np.zeros((C, delta_cap), dtype=np.uint32),
+                "h2": np.zeros((C, delta_cap), dtype=np.uint32),
+            }
         return S.ApproxCountDistinctState(
             np.zeros((C, hll.M), dtype=np.int8)
         )
 
     def update(state, batch, consts_in=None):
+        registers = (
+            state["registers"] if delta_cap is not None else state.registers
+        )
         masks = _shared_stack(batch, columns, "mask")
         masks = masks & _shared_rows(batch, where_fn, where)[None, :]
         if value_repr == "codes":
             codes = _shared_stack(batch, columns, "codes").astype(
                 jnp.int32
             )
-            lut1, lut2 = consts_in["h1"], consts_in["h2"]
+            if delta_cap is not None:
+                lut1, lut2 = state["h1"], state["h2"]
+            else:
+                lut1, lut2 = consts_in["h1"], consts_in["h2"]
             if lut1.shape[1] <= hll.PRESENCE_DICT_CAP:
                 # small dictionaries: presence compare-reduce + one
                 # D-element scatter — bit-identical registers, no
@@ -466,7 +523,7 @@ def _build_hll_group(
                         sorted_all[row_of[c]],
                         batch[f"{c}::values"],
                         masks[i],
-                        state.registers[i],
+                        registers[i],
                     )
                     if c in gated
                     else hll.dedup_column_registers_from_sorted(
@@ -482,32 +539,99 @@ def _build_hll_group(
             # adaptive: sorted-dedup for mid-cardinality groups (gated
             # by the carried registers), full scatter otherwise
             regs = hll.numeric_registers_adaptive(
-                x, masks, state.registers
+                x, masks, registers
             )
-        return S.ApproxCountDistinctState(
-            jnp.maximum(state.registers, regs)
-        )
+        new_regs = jnp.maximum(registers, regs)
+        if delta_cap is not None:
+            return {
+                "registers": new_regs,
+                "h1": state["h1"],
+                "h2": state["h2"],
+            }
+        return S.ApproxCountDistinctState(new_regs)
 
     def extract(state, member_idx: int):
-        return S.ApproxCountDistinctState(
-            state.registers[member_cols[member_idx]]
+        regs = (
+            state["registers"] if delta_cap is not None else state.registers
         )
+        return S.ApproxCountDistinctState(regs[member_cols[member_idx]])
+
+    if delta_cap is not None:
+        col_index = {c: i for i, c in enumerate(columns)}
+        # host mirrors of the hash LUT rows: deltas append into these,
+        # then ONE row overwrite lands in the device state — the mirror
+        # is what lets a delta be an append instead of a re-hash
+        mirrors = {
+            c: (
+                np.zeros(delta_cap, dtype=np.uint32),
+                np.zeros(delta_cap, dtype=np.uint32),
+            )
+            for c in columns
+        }
+
+        def merge(a, b):
+            # registers are the real monoid; the LUT leaves follow the
+            # same dictionary progression in every shard, so a
+            # commutative maximum is an identity-preserving merge
+            return {
+                "registers": jnp.maximum(a["registers"], b["registers"]),
+                "h1": jnp.maximum(a["h1"], b["h1"]),
+                "h2": jnp.maximum(a["h2"], b["h2"]),
+            }
+
+        def host_delta(state, deltas):
+            from deequ_tpu.analyzers.base import DELTA_PRIME
+
+            if deltas is DELTA_PRIME:
+                items = [(c, 0, dataset.dictionary(c)) for c in columns]
+            else:
+                items = [
+                    (c, d["start"], d["values"])
+                    for c, d in deltas.items()
+                    if c in col_index
+                ]
+            if not items:
+                return state
+            h1s, h2s = state["h1"], state["h2"]
+            for c, start, values in items:
+                n = len(values)
+                if start + n > delta_cap:
+                    raise _delta_overflow(c, start + n, delta_cap)
+                m1, m2 = mirrors[c]
+                if start == 0:  # full (re)ship: reset the mirror
+                    m1[:] = 0
+                    m2[:] = 0
+                p1, p2 = hll.dictionary_hash_pairs(
+                    # lint-ok: sync-discipline: delta VALUES are host
+                    # numpy strings off the parquet reader, not device
+                    np.asarray(values, dtype=object)
+                )
+                m1[start:start + n] = p1
+                m2[start:start + n] = p2
+                h1s = _set_lut_row(h1s, col_index[c], m1)
+                h2s = _set_lut_row(h2s, col_index[c], m2)
+            return {"registers": state["registers"], "h1": h1s, "h2": h2s}
+
+    else:
+        merge = S.ApproxCountDistinctState.merge
 
     token = _group_token(
         "hll",
         dataset,
         columns,
         where,
-        extra=(value_repr, kll_pool_columns, runtime_gate_columns),
+        extra=(value_repr, kll_pool_columns, runtime_gate_columns,
+               delta_cap),
     )
     return ScanUnit(
         members,
         ScanOps(
             init,
             update,
-            S.ApproxCountDistinctState.merge,
+            merge,
             consts=consts,
             cache_token=token,
+            host_delta=host_delta,
         ),
         requests,
         extract,
@@ -680,26 +804,47 @@ def _build_datatype_group(
     ] + where_reqs
     C = len(columns)
 
-    luts = []
-    for c in columns:
-        dictionary = dataset.dictionary(c)
-        lut = np.zeros(max(len(dictionary), 1), dtype=np.int32)
-        for i, value in enumerate(dictionary):
-            lut[i] = (
-                S.DataTypeHistogram.NULL
-                if value is None
-                else classify_string(str(value))
-            )
-        luts.append(lut)
-    consts = {"lut": _stack_luts(luts, S.DataTypeHistogram.STRING)}
+    def _classify(value) -> int:
+        return (
+            S.DataTypeHistogram.NULL
+            if value is None
+            else classify_string(str(value))
+        )
+
+    consts = None
+    host_delta = None
+    # one-pass dictionary deltas: bucket LUT in STATE, classified
+    # incrementally from each delta's values (see _build_hll_group)
+    delta_cap = _delta_cap_of(dataset, columns)
+    if delta_cap is None:
+        luts = []
+        for c in columns:
+            dictionary = dataset.dictionary(c)
+            lut = np.zeros(max(len(dictionary), 1), dtype=np.int32)
+            for i, value in enumerate(dictionary):
+                lut[i] = _classify(value)
+            luts.append(lut)
+        consts = {"lut": _stack_luts(luts, S.DataTypeHistogram.STRING)}
 
     def init():
-        return {"counts": np.zeros((C, 6), dtype=np.int64)}
+        state = {"counts": np.zeros((C, 6), dtype=np.int64)}
+        if delta_cap is not None:
+            # padding classifies as STRING like the consts path; rows
+            # beyond the shipped dictionary are never indexed by a
+            # valid code, so the fill never reaches a count
+            state["lut"] = np.full(
+                (C, delta_cap),
+                S.DataTypeHistogram.STRING,
+                dtype=np.int32,
+            )
+        return state
 
-    def update(state, batch, consts_in):
+    def update(state, batch, consts_in=None):
         from deequ_tpu.sketches.hll import PRESENCE_DICT_CAP
 
-        table = consts_in["lut"]
+        table = (
+            state["lut"] if delta_cap is not None else consts_in["lut"]
+        )
         rows = _shared_rows(batch, where_fn, where)
         masks = _shared_stack(batch, columns, "mask")
         valid = masks & rows[None, :]
@@ -727,20 +872,76 @@ def _build_datatype_group(
                 .add(1)
                 .reshape(C, 8)[:, :6]
             )
-        return {"counts": state["counts"] + counts.astype(jnp.int64)}
+        new_counts = state["counts"] + counts.astype(jnp.int64)
+        if delta_cap is not None:
+            return {"counts": new_counts, "lut": state["lut"]}
+        return {"counts": new_counts}
 
     def merge(a, b):
-        return {"counts": a["counts"] + b["counts"]}
+        out = {"counts": a["counts"] + b["counts"]}
+        if delta_cap is not None:
+            # every shard follows the same dictionary progression, so
+            # maximum preserves the (identical) LUTs
+            out["lut"] = jnp.maximum(a["lut"], b["lut"])
+        return out
 
     def extract(state, member_idx: int):
         return S.DataTypeHistogram(
             state["counts"][member_cols[member_idx]]
         )
 
-    token = _group_token("datatype", dataset, columns, where)
+    if delta_cap is not None:
+        col_index = {c: i for i, c in enumerate(columns)}
+        mirrors = {
+            c: np.full(
+                delta_cap, S.DataTypeHistogram.STRING, dtype=np.int32
+            )
+            for c in columns
+        }
+
+        def host_delta(state, deltas):
+            from deequ_tpu.analyzers.base import DELTA_PRIME
+
+            if deltas is DELTA_PRIME:
+                items = [(c, 0, dataset.dictionary(c)) for c in columns]
+            else:
+                items = [
+                    (c, d["start"], d["values"])
+                    for c, d in deltas.items()
+                    if c in col_index
+                ]
+            if not items:
+                return state
+            lut = state["lut"]
+            for c, start, values in items:
+                n = len(values)
+                if start + n > delta_cap:
+                    raise _delta_overflow(c, start + n, delta_cap)
+                row = mirrors[c]
+                if start == 0:  # full (re)ship: reset the mirror
+                    row[:] = S.DataTypeHistogram.STRING
+                if n:
+                    row[start:start + n] = np.fromiter(
+                        (_classify(v) for v in values),
+                        dtype=np.int32,
+                        count=n,
+                    )
+                lut = _set_lut_row(lut, col_index[c], row)
+            return {"counts": state["counts"], "lut": lut}
+
+    token = _group_token(
+        "datatype", dataset, columns, where, extra=(delta_cap,)
+    )
     return ScanUnit(
         members,
-        ScanOps(init, update, merge, consts=consts, cache_token=token),
+        ScanOps(
+            init,
+            update,
+            merge,
+            consts=consts,
+            cache_token=token,
+            host_delta=host_delta,
+        ),
         requests,
         extract,
     )

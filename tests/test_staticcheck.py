@@ -697,6 +697,123 @@ class TestMalformedFiles:
 
 
 # --------------------------------------------------------------------------
+# wire-discipline
+# --------------------------------------------------------------------------
+
+
+WIRE_DATA_PUT = """
+    import jax
+    import numpy as np
+
+    def ship(batch):
+        return jax.device_put(np.asarray(batch))
+"""
+
+WIRE_DATA_HOST_ONLY = """
+    import numpy as np
+
+    def ship(batch):
+        return np.ascontiguousarray(batch)
+"""
+
+WIRE_LOOPED_NARROWING = """
+    from deequ_tpu.data.table import narrow_codes
+
+    def stream(batches, dict_sizes):
+        for b, n in zip(batches, dict_sizes):
+            yield narrow_codes(b, n)
+"""
+
+WIRE_ONCE_PER_RUN_NARROWING = """
+    from deequ_tpu.data.table import narrow_codes
+
+    def plan(column, dict_size):
+        codes = narrow_codes(column, dict_size)
+        return [codes[i] for i in range(len(codes))]
+"""
+
+
+class TestWireDiscipline:
+    def test_catches_device_put_in_data_layer(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/data/rogue.py", WIRE_DATA_PUT)
+        found = _rules_found(tmp_path, "wire-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "jax.device_put"
+        assert "data layer" in found[0].message
+
+    def test_catches_jit_in_data_layer(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/data/rogue.py",
+            """
+            import jax
+
+            def compile_helper(fn):
+                return jax.jit(fn)
+            """,
+        )
+        found = _rules_found(tmp_path, "wire-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "jax.jit"
+
+    def test_silent_on_host_only_data_module(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/data/clean.py", WIRE_DATA_HOST_ONLY)
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_device_put_outside_data_layer_is_fine(self, tmp_path):
+        """The engine owns device placement; the rule must not leak
+        beyond deequ_tpu/data/."""
+        _write(tmp_path, "deequ_tpu/engine/mover.py", WIRE_DATA_PUT)
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_catches_narrowing_call_inside_loop(self, tmp_path):
+        _write(
+            tmp_path, "deequ_tpu/data/table.py", WIRE_LOOPED_NARROWING
+        )
+        found = _rules_found(tmp_path, "wire-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "narrow_codes"
+        assert "fixed-layout" in found[0].message
+
+    def test_silent_on_once_per_run_narrowing(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/data/table.py",
+            WIRE_ONCE_PER_RUN_NARROWING,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_narrowing_in_loop_outside_wire_path_is_fine(self, tmp_path):
+        """Only the wire-path modules carry the fixed-layout contract;
+        a test helper looping over widths must not trip the gate."""
+        _write(
+            tmp_path,
+            "deequ_tpu/sketches/widths.py",
+            WIRE_LOOPED_NARROWING,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_waiver_silences_with_reason(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/data/rogue.py",
+            """
+            import jax
+            import numpy as np
+
+            def ship(batch):
+                # lint-ok: wire-discipline: fixture exercising waivers
+                return jax.device_put(np.asarray(batch))
+            """,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+        findings = run_analyzers(str(tmp_path))
+        waived = [f for f in findings if f.waived]
+        assert len(waived) == 1
+        assert waived[0].waive_reason == "fixture exercising waivers"
+
+
+# --------------------------------------------------------------------------
 # CLI / JSON artifact
 # --------------------------------------------------------------------------
 
@@ -736,6 +853,7 @@ class TestCli:
             "trace-hazard",
             "plan-key",
             "sync-discipline",
+            "wire-discipline",
         ):
             assert f"{rule}:" in out
 

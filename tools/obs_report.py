@@ -39,6 +39,43 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f} GiB"
 
 
+def render_wire_diet(summary: Dict[str, Any]) -> str:
+    """The streaming wire-diet line (docs/PERF.md): bytes/row raw vs
+    encoded, the effective host->device link rate (raw bytes the
+    encoded transfer REPRESENTS per second of put wall), and the
+    dictionary-delta traffic. Empty string when the run shipped no
+    encoded wire (resident runs, codecs off with no counter)."""
+    counters = summary.get("counters", {})
+    raw = float(counters.get("engine.wire_bytes_raw", 0))
+    encoded = float(counters.get("engine.wire_bytes_encoded", 0))
+    if encoded <= 0:
+        return ""
+    rows = max(
+        (int(p.get("rows", 0)) for p in summary.get("passes", [])),
+        default=0,
+    )
+    parts = []
+    if rows > 0:
+        parts.append(
+            f"{raw / rows:.1f} -> {encoded / rows:.1f} bytes/row"
+        )
+    else:
+        parts.append(f"{_fmt_bytes(raw)} -> {_fmt_bytes(encoded)}")
+    parts.append(f"{raw / encoded:.2f}x thinner")
+    phases = summarize_phases(summary.get("events", []))
+    put_s = float(phases.get("put_s", 0.0)) if phases else 0.0
+    if put_s > 0:
+        parts.append(
+            f"effective link {raw / put_s / (1024 * 1024):,.0f} MiB/s"
+            f" (physical {encoded / put_s / (1024 * 1024):,.0f})"
+        )
+    deltas = int(counters.get("engine.dict_deltas", 0))
+    if deltas:
+        values = int(counters.get("engine.dict_delta_values", 0))
+        parts.append(f"{deltas} dict delta(s), {values} value(s)")
+    return "  wire diet: " + ", ".join(parts)
+
+
 def render_run(summary: Dict[str, Any]) -> str:
     """One run's breakdown: pass table, wall decomposition, counters."""
     lines = []
@@ -52,6 +89,10 @@ def render_run(summary: Dict[str, Any]) -> str:
         # the one-pass-spill headline number: a mixed suite (scalars +
         # dense grouping + spill plans) should read 1 here
         lines.append(f"  passes over source: {int(data_passes)}")
+
+    wire_line = render_wire_diet(summary)
+    if wire_line:
+        lines.append(wire_line)
 
     passes = summary.get("passes", [])
     if passes:

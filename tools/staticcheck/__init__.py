@@ -1,9 +1,9 @@
 """AST-based static-analysis suite for the deequ_tpu tree.
 
 Importing this package registers the default analyzers (lock
-discipline, interrupt safety, trace hazards, plan-key discipline, and
-the token rules migrated from tools.telemetry_lint) on the shared
-registry. Entry points:
+discipline, interrupt safety, trace hazards, plan-key discipline,
+wire discipline, and the token rules migrated from
+tools.telemetry_lint) on the shared registry. Entry points:
 
     python -m tools.staticcheck [root] [--json] [--rules a,b] [--all]
 
@@ -32,5 +32,6 @@ from tools.staticcheck import locks as _locks  # noqa: F401,E402
 from tools.staticcheck import plankey as _plankey  # noqa: F401,E402
 from tools.staticcheck import tokens as _tokens  # noqa: F401,E402
 from tools.staticcheck import trace as _trace  # noqa: F401,E402
+from tools.staticcheck import wire_discipline as _wire_discipline  # noqa: F401,E402
 
 run = run_analyzers

@@ -1,0 +1,144 @@
+"""Wire-discipline analyzer: the data layer stays on the host, and
+wire dtype decisions stay out of per-batch loops.
+
+The wire diet (docs/PERF.md) only works if layering holds:
+
+``wire-discipline`` — two checks over the wire path:
+
+1. Modules under ``deequ_tpu/data/`` may not call ``jax.device_put``
+   or ``jax.jit`` (or ``jax.pmap``). Device placement belongs to the
+   engine — a data-layer put bypasses the wire pack (masks at 1
+   bit/row, per-column codecs, transfer accounting) and ships fat
+   unencoded buffers. The handful of deliberate resident-path helpers
+   in ``data/table.py`` (device-built row masks, the fused mask
+   unpack, the chunk-cache put that IS the resident wire) carry
+   reasoned waivers.
+
+2. In wire-path modules (``deequ_tpu/data/table.py``,
+   ``deequ_tpu/data/parquet.py``, ``deequ_tpu/engine/scan.py``,
+   ``deequ_tpu/engine/wire.py``), the wire-narrowing helpers
+   (``narrow_int64_values``, ``narrow_codes``,
+   ``narrowest_int_dtype``) must not be called lexically inside a
+   ``for``/``while`` loop. A per-batch narrowing decision makes
+   streamed batch dtypes depend on batch CONTENT, which breaks the
+   fixed-layout no-recompile contract (``narrow_int64_values``
+   docstring): one cold batch widens the wire and retraces the fused
+   scan. Narrowing is decided once per run — from parquet statistics,
+   a first-batch probe, or the whole materialized column.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+DATA_PREFIX = "deequ_tpu/data/"
+#: jax entry points that place or compile for a device
+DEVICE_CALLS = frozenset({"jax.device_put", "jax.jit", "jax.pmap"})
+WIRE_PATH_FILES = (
+    "deequ_tpu/data/table.py",
+    "deequ_tpu/data/parquet.py",
+    "deequ_tpu/engine/scan.py",
+    "deequ_tpu/engine/wire.py",
+)
+#: dtype-deciding helpers; calling one per batch breaks the
+#: fixed-layout contract
+NARROWING_TAILS = frozenset(
+    {"narrow_int64_values", "narrow_codes", "narrowest_int_dtype"}
+)
+
+
+class _WireScanner(ast.NodeVisitor):
+    """One pass over a module: device-placement calls, and narrowing
+    calls tagged with the lexical loop depth at the call site."""
+
+    def __init__(self) -> None:
+        self.loop_depth = 0
+        self.device_calls: List[Tuple[str, int]] = []
+        self.looped_narrowing: List[Tuple[str, int]] = []
+
+    def _visit_loop(self, node: ast.AST) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # a nested def inside a loop body runs per iteration only if called
+    # there; but in this codebase closures defined in loops are rare
+    # and a narrowing call inside one is exactly as per-batch as an
+    # inline call, so the loop depth deliberately carries through.
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = dotted_name(node.func)
+        if callee:
+            if callee in DEVICE_CALLS or callee.endswith(".device_put"):
+                self.device_calls.append((callee, node.lineno))
+            tail = callee.split(".")[-1]
+            if tail in NARROWING_TAILS and self.loop_depth > 0:
+                self.looped_narrowing.append((tail, node.lineno))
+        self.generic_visit(node)
+
+
+class WireDisciplineAnalyzer(Analyzer):
+    name = "wire"
+    rules = ("wire-discipline",)
+    description = (
+        "device placement calls in the host-only data layer; "
+        "per-batch wire-narrowing decisions in loops"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            in_data = sf.rel.startswith(DATA_PREFIX)
+            in_wire_path = sf.rel in WIRE_PATH_FILES
+            if not (in_data or in_wire_path) or sf.tree is None:
+                continue
+            scanner = _WireScanner()
+            scanner.visit(sf.tree)
+            if in_data:
+                for callee, line in scanner.device_calls:
+                    yield Finding(
+                        rule="wire-discipline",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"'{callee}' in the host-only data layer: "
+                            "device placement belongs to the engine's "
+                            "wire (pack -> put -> fused unpack); a "
+                            "data-layer put ships unencoded buffers "
+                            "and bypasses transfer accounting"
+                        ),
+                        symbol=callee,
+                    )
+            if in_wire_path:
+                for tail, line in scanner.looped_narrowing:
+                    yield Finding(
+                        rule="wire-discipline",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"'{tail}' called inside a loop: a "
+                            "per-batch narrowing decision makes "
+                            "streamed dtypes content-dependent and "
+                            "retraces the fused scan (fixed-layout "
+                            "contract, narrow_int64_values docstring); "
+                            "decide the wire dtype once per run"
+                        ),
+                        symbol=tail,
+                    )
+
+
+register(WireDisciplineAnalyzer())

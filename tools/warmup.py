@@ -190,6 +190,15 @@ def default_engine_variants(schema) -> list:
     with config.configure(pallas_scatter=True):
         if pallas_scatter.impl_token() == "pallas":
             variants.append({"pallas_scatter": True})
+    # streaming wire, codecs on AND off: the codec-table token rides
+    # the streaming plan fingerprint (engine/scan.py), so the codec-on
+    # wire and the codecs-off differential oracle are two distinct
+    # plans — warm both with the device cache off (the resident passes
+    # above never build a wire). The probe-resolved codec table for
+    # the synthetic data matches production only as far as the
+    # synthetic value ranges do (wide_ints covers both int widths).
+    variants.append({"device_cache_bytes": 0})
+    variants.append({"device_cache_bytes": 0, "wire_codecs": False})
     return variants
 
 
