@@ -720,6 +720,162 @@ def bench_streaming_wire_diet(num_rows: int = 4_000_000):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_streaming_ingest_parallel(
+    num_rows: int = 4_000_000, num_cols: int = 10
+):
+    """Parallel-ingest config (docs/PERF.md r10): the SAME multi-file
+    parquet table streamed at ingest_workers ∈ {1, 2, 4} — workers=1
+    is the legacy single-prefetcher oracle, workers>1 the ordered
+    decode/encode pool — so the wall delta is attributable to host
+    decode overlap alone. The analyzer suite is one-pass on purpose
+    (scalars + codes-borne ACD/DataType; no dictionary materializer)
+    and the artifact pins data_passes == 1 per run plus bit-identical
+    metrics across worker counts. NOTE the host matters: the pool
+    overlaps HOST decode across cores, so on a 1-core container the
+    w4/w1 speedup reads ~1.0x by construction — host_cpu_count is in
+    the artifact so the verdict can tell a regression from a small
+    host."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import config
+    from deequ_tpu.analyzers import (
+        AnalysisRunner,
+        ApproxCountDistinct,
+        Completeness,
+        DataType,
+        Maximum,
+        Mean,
+        Minimum,
+    )
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.telemetry import get_telemetry
+
+    rng = np.random.default_rng(23)
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_bench_ingest_")
+    analyzers = [
+        Mean("f0"), Minimum("f0"), Maximum("f0"),
+        Mean("f1"), Completeness("f2"),
+        Minimum("k0"), Maximum("k1"), ApproxCountDistinct("k2"),
+        # ACD + DataType PAIRED per string column: the pair rides one
+        # pooled codes unit inside the single pass; a lone string
+        # analyzer would trigger the dictionary pre-pass and break the
+        # data_passes == 1 pin this config asserts
+        ApproxCountDistinct("s0"), DataType("s0"),
+        ApproxCountDistinct("s1"), DataType("s1"),
+    ]
+    try:
+        shard_rows = num_rows // 4
+        cats = np.array([f"cat_{j:04d}" for j in range(512)])
+        for i in range(4):
+            rows = num_rows - 3 * shard_rows if i == 3 else shard_rows
+            f = rng.normal(100.0, 25.0, rows).astype(np.float32)
+            f2 = f.astype(np.float64)
+            f2[rng.integers(0, rows, rows // 50)] = np.nan
+            pq.write_table(
+                pa.table(
+                    {
+                        "f0": pa.array(f.astype(np.float64)),
+                        "f1": pa.array(np.abs(f).astype(np.float64)),
+                        "f2": pa.array(f2, mask=np.isnan(f2)),
+                        "k0": pa.array(
+                            rng.integers(0, 30_000, rows, dtype=np.int64)
+                        ),
+                        "k1": pa.array(
+                            rng.integers(0, 100, rows, dtype=np.int64)
+                        ),
+                        "k2": pa.array(
+                            rng.integers(0, 1 << 20, rows, dtype=np.int64)
+                        ),
+                        "s0": pa.array(
+                            cats[rng.integers(0, len(cats), rows)]
+                        ),
+                        "s1": pa.array(cats[rng.integers(0, 64, rows)]),
+                    }
+                ),
+                f"{workdir}/part{i}.parquet",
+            )
+
+        tm = get_telemetry()
+
+        def run(workers: int):
+            with config.configure(
+                device_cache_bytes=0,
+                batch_size=1 << 19,
+                wire_codecs=True,
+                dict_deltas=True,
+                ingest_workers=workers,
+            ):
+                AnalysisRunner.do_analysis_run(  # warm the plan
+                    Dataset.from_parquet(workdir), analyzers
+                )
+                passes0 = tm.counter("engine.data_passes").value
+                wall, shipped, mbps, ctx = _timed(
+                    lambda: AnalysisRunner.do_analysis_run(
+                        Dataset.from_parquet(workdir), analyzers
+                    )
+                )
+                events = (
+                    ctx.run_metadata.events if ctx.run_metadata else []
+                )
+                pool = {}
+                for e in events:
+                    if e.get("event") == "ingest_pool":
+                        for k in (
+                            "workers", "released", "decode_s",
+                            "encode_s", "idle_s", "stall_s", "wall_s",
+                            "peak_in_flight", "peak_in_flight_bytes",
+                        ):
+                            pool[k] = pool.get(k, 0) + e.get(k, 0)
+                phases = _phases(ctx.run_metadata)
+                out = {
+                    "wall_s": wall,
+                    "rows_per_sec": num_rows / wall,
+                    "link_mb_per_sec": mbps,
+                    "data_passes": (
+                        tm.counter("engine.data_passes").value - passes0
+                    ),
+                    # decode wall vs run wall: >1x aggregate decode_s
+                    # per wall second means the pool really overlapped
+                    "host_wait_s": phases.get("host_wait_s", 0.0),
+                    "phases": phases,
+                }
+                if pool:
+                    out["pool"] = pool
+                    out["decode_overlap_x"] = (
+                        (pool["decode_s"] + pool["encode_s"]) / wall
+                        if wall > 0 else 0.0
+                    )
+                metrics = {
+                    (m.instance, m.name): m.value
+                    for m in ctx.all_metrics()
+                }
+                return out, metrics
+
+        results = {}
+        baselines = None
+        identical = True
+        for w in (1, 2, 4):
+            results[f"workers_{w}"], metrics = run(w)
+            if baselines is None:
+                baselines = metrics
+            elif metrics != baselines:
+                identical = False
+        w1 = results["workers_1"]["wall_s"]
+        return {
+            **results,
+            "metrics_identical_across_workers": identical,
+            "speedup_w2": w1 / results["workers_2"]["wall_s"],
+            "speedup_w4": w1 / results["workers_4"]["wall_s"],
+            "host_cpu_count": os.cpu_count(),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_resilience_overhead(num_rows: int = 4_000_000):
     """Resilience tax on a CLEAN scan (docs/RESILIENCE.md): the same
     streaming fused-bundle run with retry + periodic checkpointing ON
@@ -1225,7 +1381,23 @@ def main(argv=None):
         action="store_true",
         help="headline profiler config only, at 1/8 scale",
     )
+    parser.add_argument(
+        "--configs",
+        default="",
+        help="comma-separated config names to run (e.g. "
+        "'streaming_ingest_parallel'); skips the headline profiler "
+        "unless 'profiler' is listed",
+    )
+    parser.add_argument(
+        "--artifact",
+        default="",
+        help="also write the full detail JSON (the stderr document) "
+        "to this path",
+    )
     args = parser.parse_args(argv)
+    wanted = {
+        name.strip() for name in args.configs.split(",") if name.strip()
+    }
 
     start = time.time()
 
@@ -1237,10 +1409,11 @@ def main(argv=None):
         (500_000, 20) if args.quick else (4_000_000, 20)
     )
     detail = {"budget_s": args.budget, "quick": args.quick, "skipped": []}
-    try:
-        detail["profiler"] = bench_profiler(prof_rows, prof_cols)
-    except Exception as exc:  # headline failure must not kill the line
-        detail["error"] = repr(exc)
+    if not wanted or "profiler" in wanted:
+        try:
+            detail["profiler"] = bench_profiler(prof_rows, prof_cols)
+        except Exception as exc:  # headline failure must not kill the line
+            detail["error"] = repr(exc)
 
     def headline_line() -> dict:
         prof = detail.get("profiler")
@@ -1332,6 +1505,12 @@ def main(argv=None):
              # on, then off); budget sized like streaming_parquet's
              # worst observed link, not its healthy-link median
              lambda: bench_streaming_wire_diet(4_000_000), 390),
+            ("streaming_ingest_parallel",
+             # three streamed passes over the same 4M-row table
+             # (workers 1/2/4, each with a warm run); sized like the
+             # other streaming configs' worst observed link
+             lambda: bench_streaming_ingest_parallel(4_000_000, 10),
+             400),
             ("streaming_bundle_100m",
              lambda: bench_streaming_bundle_100m(), 330),
         ]
@@ -1364,6 +1543,8 @@ def main(argv=None):
         return result
 
     for name, thunk, est_s in secondary:
+        if wanted and name not in wanted:
+            continue
         if remaining() < est_s:
             detail["skipped"].append(
                 {
@@ -1413,6 +1594,10 @@ def main(argv=None):
 
     result = merge_wide(headline_line())
     print(json.dumps(detail, indent=2), file=sys.stderr)
+    if args.artifact:
+        with open(args.artifact, "w", encoding="utf-8") as fh:
+            json.dump(detail, fh, indent=2)
+            fh.write("\n")
     print(json.dumps(result))
 
 

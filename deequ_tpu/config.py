@@ -114,6 +114,38 @@ class Options:
     dict_delta_capacity: int = int(
         os.environ.get("DEEQU_TPU_DICT_DELTA_CAPACITY", 1 << 16)
     )
+    # parallel host ingest (engine/ingest.py, docs/PERF.md "r10"):
+    # decode/encode worker threads feeding the streaming scan through
+    # the ordered reassembly stage. 0 = auto (min(4, cpu count));
+    # 1 = the single-prefetch-thread path, bit-identical to the
+    # pre-pool engine (the differential oracle). Host-pipeline only:
+    # never part of the plan fingerprint — flipping it must not
+    # retrace or recompile anything
+    ingest_workers: int = int(
+        os.environ.get("DEEQU_TPU_INGEST_WORKERS", 0) or 0
+    )
+    # bounded prefetch queue depth for the single-worker path (the
+    # old hard-coded depth=2 of engine/scan._prefetched); host-pipeline
+    # only, plan-fingerprint-neutral like ingest_workers
+    ingest_depth: int = int(
+        os.environ.get("DEEQU_TPU_INGEST_DEPTH", 2) or 2
+    )
+    # max batches in flight inside the ingest pool (queued + decoding
+    # + decoded-awaiting-ordered-release); bounds host memory under
+    # the PR 5 admission watermark. 0 = auto (2 * workers)
+    ingest_lookahead: int = int(
+        os.environ.get("DEEQU_TPU_INGEST_LOOKAHEAD", 0) or 0
+    )
+    # process-sharded ingest on the mesh streaming path: each process
+    # reads only its own row-group shard (ParquetDataset.shard_view)
+    # and feeds ONE global array per batch leaf via
+    # jax.make_array_from_process_local_data (SNIPPETS.md [2]
+    # partitioner pattern). With a single process this is exactly the
+    # plain device_put feed; multi-process runs also perform the r5
+    # uniform compile-failure exchange so no host strands its peers
+    process_sharded_ingest: bool = (
+        os.environ.get("DEEQU_TPU_PROCESS_SHARDED_INGEST", "1") != "0"
+    )
     # persistent XLA compilation cache directory ("" disables)
     compilation_cache_dir: str = os.environ.get(
         "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")

@@ -70,16 +70,33 @@ class _IncrementalDict:
     def absorb_and_encode(self, column: pa.Array) -> np.ndarray:
         if pa.types.is_dictionary(column.type):
             column = pc.cast(column, column.type.value_type)
-        u = pc.drop_null(pc.unique(column))
-        if len(u):
+        self.absorb(pc.drop_null(pc.unique(column)))
+        return self.encode(column)
+
+    # r10 split: the ordered ingest pool computes a batch's uniques on
+    # a WORKER thread (pc.unique is order-free) and runs absorb+encode
+    # on the consumer at ordered release, so accumulator growth — the
+    # one order-dependent step — happens exactly as the single-thread
+    # path would. absorb(unique(b0)); absorb(unique(b1)); ... yields
+    # the identical first-occurrence dictionary whatever the chunking.
+
+    def absorb(self, uniques: pa.Array) -> None:
+        """Append a batch's new uniques in first-occurrence order."""
+        if len(uniques):
             if self.values is None:
-                self.values = u
+                self.values = uniques
             else:
-                idx = pc.index_in(u, value_set=self.values)
-                new = u.filter(pc.is_null(idx))
+                idx = pc.index_in(uniques, value_set=self.values)
+                new = uniques.filter(pc.is_null(idx))
                 if len(new):
                     self.values = pa.concat_arrays([self.values, new])
             self.n = len(self.values)
+
+    def encode(self, column: pa.Array) -> np.ndarray:
+        """int32 codes against the accumulator as absorbed so far
+        (nulls and unseen values index to -1)."""
+        if pa.types.is_dictionary(column.type):
+            column = pc.cast(column, column.type.value_type)
         if self.values is None or self.n == 0:
             return np.full(len(column), -1, dtype=np.int32)
         idx = pc.index_in(column, value_set=self.values)
@@ -140,9 +157,21 @@ def _column_batch_to_reprs(
 class ParquetDataset(Dataset):
     """A Dataset over parquet file(s)/directory, scanned lazily."""
 
+    # r10: class-level opt-in for the ordered ingest pool — the engine
+    # engages ``ingest_work_items`` only on classes that declare this
+    # (a __getattr__-delegating wrapper must define its own planner)
+    supports_parallel_ingest = True
+
     def __init__(self, source, read_batch_rows: int = 1 << 20):
         # no super().__init__: there is no in-memory table
-        self._source = pads.dataset(source, format="parquet")
+        # a prebuilt pyarrow dataset (the shard_view planner's
+        # row-group-restricted FileSystemDataset) passes through as-is
+        self._source = (
+            source
+            if isinstance(source, pads.Dataset)
+            else pads.dataset(source, format="parquet")
+        )
+        self._shard_tag = None
         self._read_batch_rows = read_batch_rows
         self._schema = Schema(
             tuple(
@@ -214,6 +243,12 @@ class ParquetDataset(Dataset):
                 h.update(f":{st.st_size}:{st.st_mtime_ns}".encode())
             except OSError:
                 pass
+        if self._shard_tag is not None:
+            # two shard views of the SAME files must not share a
+            # checkpoint identity (their row streams differ)
+            h.update(
+                f"shard:{self._shard_tag[0]}/{self._shard_tag[1]}".encode()
+            )
         h.update(str(self._num_rows).encode())
         return f"parquet-{h.hexdigest()[:20]}"
 
@@ -674,6 +709,207 @@ class ParquetDataset(Dataset):
                 ):
                     self._store_dictionary(c, accs[c].values)
 
+    # -- process-sharded ingest (ROADMAP item 3) -------------------------
+
+    def shard_row_groups(
+        self, process_index: int, process_count: int
+    ) -> list:
+        """Deterministic balanced row-group assignment: greedy
+        least-loaded-by-rows over every (path-sorted) fragment's row
+        groups. Every process computes the SAME full assignment from
+        the same metadata, so the shards are a disjoint cover with no
+        coordination. Returns this process's row-group fragments (in
+        source order)."""
+        if process_count <= 0:
+            raise ValueError("process_count must be positive")
+        if not 0 <= process_index < process_count:
+            raise ValueError(
+                f"process_index {process_index} outside "
+                f"[0, {process_count})"
+            )
+        groups = []  # (rows, file_order, rg_order, fragment)
+        fragments = sorted(
+            self._source.get_fragments(), key=lambda f: f.path
+        )
+        for fi, fragment in enumerate(fragments):
+            meta = fragment.metadata
+            for gi, sub in enumerate(fragment.split_by_row_group()):
+                groups.append(
+                    (int(meta.row_group(gi).num_rows), fi, gi, sub)
+                )
+        loads = [0] * process_count
+        assign: list = [[] for _ in range(process_count)]
+        # largest-first greedy; ties broken by source order, target
+        # ties by process index — fully deterministic
+        for rows, fi, gi, sub in sorted(
+            groups, key=lambda g: (-g[0], g[1], g[2])
+        ):
+            p = min(range(process_count), key=lambda i: (loads[i], i))
+            loads[p] += rows
+            assign[p].append((fi, gi, sub))
+        return [
+            sub for _, _, sub in sorted(assign[process_index])
+        ]
+
+    def shard_view(
+        self, process_index: int, process_count: int
+    ) -> "ParquetDataset":
+        """This process's shard as a full ParquetDataset: a pyarrow
+        FileSystemDataset restricted to the assigned row-group
+        fragments (reads touch ONLY those row groups), fingerprint
+        tagged with (process_index, process_count) so shard checkpoints
+        never collide with whole-source ones."""
+        fragments = self.shard_row_groups(process_index, process_count)
+        view = ParquetDataset(
+            pads.FileSystemDataset(
+                fragments,
+                self._source.schema,
+                self._source.format,
+                self._source.filesystem,
+            ),
+            self._read_batch_rows,
+        )
+        view._shard_tag = (int(process_index), int(process_count))
+        # count_rows() on a row-group-restricted fragment reports the
+        # WHOLE file (pyarrow quirk; scans are correctly restricted) —
+        # recount from the assigned row-group metadata
+        view._num_rows = sum(
+            int(rg.num_rows)
+            for fragment in fragments
+            for rg in fragment.row_groups
+        )
+        return view
+
+    # -- r10 ordered-pool work items -------------------------------------
+
+    def ingest_work_items(
+        self,
+        requests: Sequence[ColumnRequest],
+        batch_size: Optional[int] = None,
+        start_batch: int = 0,
+    ):
+        """Work-item twin of ``device_batches`` for the ordered ingest
+        pool (engine/ingest.py). The READER (this generator) does only
+        Arrow-level slicing to engine-batch granularity — zero-copy,
+        and parquet decompression is already parallel inside the
+        pyarrow scanner. Each item's heavy conversion runs on a pool
+        WORKER via ``item.decode()`` (numpy reprs + per-batch uniques
+        for delta columns — order-free work), and ``item.commit``
+        runs strictly in batch order on the consumer (accumulator
+        absorb, codes, delta cut, end-of-stream dictionary caching —
+        all the order-dependent machinery).
+
+        ``device_batches`` is deliberately untouched: workers=1 runs
+        it, byte for byte the pre-r10 single-thread path — the
+        differential oracle the pool tests pin against."""
+        n = self.num_rows
+        if batch_size is None:
+            batch_size = n if n > 0 else 1
+        batch_size = max(1, batch_size)
+        skip_rows = start_batch * batch_size
+
+        keys = self._dedup_requests(requests)
+        by_column: Dict[str, List[str]] = {}
+        for r in keys.values():
+            by_column.setdefault(r.column, []).append(r.repr)
+        columns = sorted(by_column)
+        if not columns or n == 0:
+            index = start_batch
+            for batch in self._empty_or_counting_batches(
+                keys, batch_size, n, skip_rows
+            ):
+                yield _PrecomputedIngestItem(index, batch)
+                index += 1
+            return
+        delta_cols = sorted(
+            c
+            for c, reprs in by_column.items()
+            if "codes" in reprs and self._dict_delta_mode(c)
+        )
+        state = _IngestPlanState(
+            dataset=self,
+            columns=columns,
+            by_column=by_column,
+            kinds={c: self._schema.kind_of(c) for c in columns},
+            delta_cols=delta_cols,
+            accs={
+                c: self._delta_dicts.setdefault(c, _IncrementalDict())
+                for c in delta_cols
+            },
+            shipped_n={c: 0 for c in delta_cols},
+            value_sets={
+                c: self._dict_value_set(c)
+                for c, reprs in by_column.items()
+                if "codes" in reprs and c not in delta_cols
+            },
+            values_dtypes={
+                c: self._values_dtype(c)
+                for c, reprs in by_column.items()
+                if "values" in reprs
+            },
+            start_batch=start_batch,
+            batch_size=batch_size,
+        )
+
+        pending: Dict[str, List[pa.Array]] = {c: [] for c in columns}
+        pending_rows = 0
+        index = start_batch
+        # one-item holdback so the LAST item can carry final=True (it
+        # owns the end-of-stream dictionary caching in commit)
+        held: Optional[_ParquetIngestItem] = None
+
+        def cut(force_pad: bool):
+            nonlocal pending_rows, index, held
+            while pending_rows >= batch_size or (
+                force_pad and pending_rows > 0
+            ):
+                width = min(pending_rows, batch_size)
+                chunks: Dict[str, List[pa.Array]] = {}
+                for c in columns:
+                    taken: List[pa.Array] = []
+                    rest: List[pa.Array] = []
+                    got = 0
+                    for arr in pending[c]:
+                        if got >= width:
+                            rest.append(arr)
+                            continue
+                        take = min(len(arr), width - got)
+                        taken.append(
+                            arr if take == len(arr) else arr.slice(0, take)
+                        )
+                        if take < len(arr):
+                            rest.append(arr.slice(take))
+                        got += take
+                    chunks[c] = taken
+                    pending[c] = rest
+                pending_rows -= width
+                item = _ParquetIngestItem(index, width, state, chunks)
+                index += 1
+                if held is not None:
+                    yield held
+                held = item
+
+        scanner = self._source.scanner(
+            columns=columns, batch_size=self._read_batch_rows
+        )
+        for record_batch in scanner.to_batches():
+            if skip_rows > 0:
+                if record_batch.num_rows <= skip_rows:
+                    skip_rows -= record_batch.num_rows
+                    continue
+                record_batch = record_batch.slice(skip_rows)
+                skip_rows = 0
+            if record_batch.num_rows == 0:
+                continue
+            for ci, column_name in enumerate(columns):
+                pending[column_name].append(record_batch.column(ci))
+            pending_rows += record_batch.num_rows
+            yield from cut(force_pad=False)
+        yield from cut(force_pad=True)
+        if held is not None:
+            held.final = True
+            yield held
+
     def _empty_or_counting_batches(
         self, keys, batch_size: int, n: int, skip_rows: int = 0
     ):
@@ -713,3 +949,175 @@ class ParquetDataset(Dataset):
             row_mask[:width] = True
             yield {ROW_MASK: row_mask}
             remaining -= width
+
+
+class _IngestPlanState:
+    """Shared, consumer-owned state of one ingest_work_items call: the
+    dictionary accumulators and delta cursors every item's ordered
+    ``commit`` mutates (only the pool consumer touches them, strictly
+    in batch order), plus the immutable per-call conversion config."""
+
+    __slots__ = (
+        "dataset",
+        "columns",
+        "by_column",
+        "kinds",
+        "delta_cols",
+        "accs",
+        "shipped_n",
+        "value_sets",
+        "values_dtypes",
+        "start_batch",
+        "batch_size",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+class _ParquetIngestItem:
+    """One engine batch's ingest work, split across pool stages:
+
+    - ``decode()`` (any WORKER thread, order-free): cast/concat the
+      Arrow chunk slices, run the authoritative repr conversion
+      (_column_batch_to_reprs), pad to batch width, build the row
+      mask; for delta columns also compute the batch's uniques
+      (pc.unique — chunking-independent) but do NOT touch the shared
+      accumulator.
+    - ``commit(decoded)`` (the CONSUMER, strictly in batch order):
+      absorb uniques into the shared _IncrementalDict, compute codes
+      against the grown accumulator, cut the {start, values} delta
+      payload, and — on the final item of an unresumed stream — cache
+      the completed dictionary, exactly like device_batches' tail.
+
+    ``complete`` is True when the item needs no ordered commit work
+    (no delta columns), letting the pool wire-pack it on the worker.
+    """
+
+    __slots__ = (
+        "index",
+        "width",
+        "final",
+        "_state",
+        "_chunks",
+        "_delta_raw",
+    )
+
+    def __init__(self, index, width, state, chunks):
+        self.index = index
+        self.width = width
+        self.final = False
+        self._state = state
+        self._chunks = chunks
+        self._delta_raw = None
+
+    @property
+    def complete(self) -> bool:
+        return not self._state.delta_cols
+
+    def decode(self) -> Dict[str, np.ndarray]:
+        st = self._state
+        bs = st.batch_size
+        delta_raw: Dict[str, tuple] = {}
+        batch: Dict[str, np.ndarray] = {}
+        for c in st.columns:
+            chunks = []
+            for arr in self._chunks[c]:
+                if pa.types.is_dictionary(arr.type):
+                    # cast per chunk: different record batches may
+                    # carry different local dictionaries, which
+                    # concat_arrays will not unify
+                    arr = pc.cast(arr, arr.type.value_type)
+                chunks.append(arr)
+            col = (
+                chunks[0]
+                if len(chunks) == 1
+                else pa.concat_arrays(chunks)
+            )
+            kind = st.kinds[c]
+            wanted = st.by_column[c]
+            if c in st.accs:
+                reprs = _column_batch_to_reprs(
+                    col, kind, [r for r in wanted if r != "codes"]
+                )
+                delta_raw[c] = (col, pc.drop_null(pc.unique(col)))
+            else:
+                reprs = _column_batch_to_reprs(
+                    col,
+                    kind,
+                    wanted,
+                    st.value_sets.get(c),
+                    st.values_dtypes.get(c),
+                )
+            for repr_name, arr in reprs.items():
+                batch[f"{c}::{repr_name}"] = arr
+        pad = bs - self.width
+        row_mask = np.ones((bs,), dtype=bool)
+        if pad:
+            row_mask[self.width:] = False
+            for k, v in list(batch.items()):
+                v = np.concatenate(
+                    [v, np.zeros((pad,), dtype=v.dtype)]
+                )
+                if k.endswith("::mask"):
+                    v = v & row_mask
+                batch[k] = v
+        batch[ROW_MASK] = row_mask
+        self._delta_raw = delta_raw
+        return batch
+
+    def commit(
+        self, decoded: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        st = self._state
+        for c, (col, uniques) in (self._delta_raw or {}).items():
+            acc = st.accs[c]
+            acc.absorb(uniques)
+            codes = acc.encode(col)
+            pad = st.batch_size - self.width
+            if pad:
+                codes = np.concatenate(
+                    [codes, np.zeros((pad,), dtype=codes.dtype)]
+                )
+            decoded[f"{c}::codes"] = np.ascontiguousarray(codes)
+            if acc.n > st.shipped_n[c]:
+                decoded[DICT_DELTA_PREFIX + c] = {
+                    "start": st.shipped_n[c],
+                    "values": acc.slice_values(st.shipped_n[c]),
+                }
+                st.shipped_n[c] = acc.n
+        # drop the Arrow references: once committed the batch is pure
+        # numpy and the column buffers can be reclaimed
+        self._delta_raw = None
+        self._chunks = None
+        if self.final and st.start_batch == 0:
+            ds = st.dataset
+            for c in st.delta_cols:
+                if (
+                    c not in ds._dictionaries
+                    and st.accs[c].values is not None
+                ):
+                    ds._store_dictionary(c, st.accs[c].values)
+        return decoded
+
+
+class _PrecomputedIngestItem:
+    """Degenerate-path item (no requested columns, or an empty
+    source): the batch is already built on the reader; decode/commit
+    are identity."""
+
+    __slots__ = ("index", "width", "final", "_batch")
+    complete = True
+
+    def __init__(self, index, batch):
+        self.index = index
+        self.width = None
+        self.final = False
+        self._batch = batch
+
+    def decode(self):
+        return self._batch
+
+    def commit(self, decoded):
+        return decoded

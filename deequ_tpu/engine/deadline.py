@@ -477,6 +477,8 @@ class Watchdog:
     def __init__(self, supervisor: ScanSupervisor):
         self._supervisor = supervisor
         self._stop = threading.Event()
+        # lint-ok: thread-discipline: watchdog has its own lifecycle —
+        # joined-with-timeout in Watchdog.stop(), not an ingest worker
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="deequ-tpu-watchdog"
         )

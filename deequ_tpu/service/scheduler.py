@@ -60,6 +60,9 @@ class Scheduler:
         self._stop.clear()
         for i in range(self.workers):
             reserved = i < self.interactive_reserve
+            # lint-ok: thread-discipline: pool workers are joined in
+            # Scheduler.stop(); registering them with the scan-scoped
+            # ingest probe would trip the between-scans leak assertion
             thread = threading.Thread(
                 target=self._worker_loop,
                 args=(Priority.INTERACTIVE if reserved else None,),

@@ -151,6 +151,9 @@ class VerificationService:
 
             self._uninstall_sigterm = install_graceful_shutdown()
             self._watcher_stop.clear()
+            # lint-ok: thread-discipline: service-scoped watcher joined
+            # in stop(); not part of a scan, so the ingest probe (which
+            # tier-1 asserts empty between scans) must not see it
             self._sigterm_watcher = threading.Thread(
                 target=self._watch_shutdown,
                 daemon=True,

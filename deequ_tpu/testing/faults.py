@@ -102,6 +102,8 @@ class FaultInjectingDataset:
         oom_transfer_at: Optional[Any] = None,
         oom_finalize: int = 0,
         oom_deferred: int = 0,
+        decode_transient: Optional[Dict[int, int]] = None,
+        decode_permanent: Optional[Iterable[int]] = None,
     ):
         self._inner = inner
         self._transient_remaining = dict(transient or {})
@@ -131,6 +133,12 @@ class FaultInjectingDataset:
         self._oom_rows_over = int(oom_rows_over)
         self._oom_finalize_remaining = int(oom_finalize)
         self._oom_deferred_remaining = int(oom_deferred)
+        # r10 worker-stage faults: fired inside ``item.decode()`` on a
+        # pool WORKER thread (simulated worker death), surfacing
+        # through the ordered reassembly stage at the batch's exact
+        # sequence position
+        self._decode_transient_remaining = dict(decode_transient or {})
+        self._decode_permanent: Set[int] = set(decode_permanent or ())
         # observability for assertions: every fault actually fired
         self.faults_fired: list = []
 
@@ -296,6 +304,52 @@ class FaultInjectingDataset:
             yield self._maybe_corrupt(index, batch)
             index += 1
 
+    # r10: the ordered ingest pool engages only on datasets whose CLASS
+    # declares support (a bare __getattr__ delegation would let the
+    # engine reach the INNER planner and silently bypass every fault
+    # here) — so the wrapper declares support exactly when its inner
+    # dataset does, and wraps each work item in its own fault surface.
+    @property
+    def supports_parallel_ingest(self) -> bool:
+        return bool(
+            getattr(type(self._inner), "supports_parallel_ingest", False)
+        )
+
+    def _check_decode_faults(self, index: int) -> None:
+        """Worker-death simulation: raised inside ``decode()`` on the
+        pool worker that picked this batch up."""
+        if index in self._decode_permanent:
+            self.faults_fired.append(("decode_permanent", index))
+            raise ValueError(
+                f"injected worker decode error at batch {index}"
+            )
+        remaining = self._decode_transient_remaining.get(index, 0)
+        if remaining > 0:
+            self._decode_transient_remaining[index] = remaining - 1
+            self.faults_fired.append(("decode_transient", index))
+            raise TransientScanError(
+                f"injected worker death at batch {index} "
+                f"({remaining - 1} more)"
+            )
+
+    def ingest_work_items(
+        self, requests, batch_size: int, start_batch: int = 0
+    ):
+        """Pool-path twin of ``device_batches``: reader-side faults
+        (hook/slow/hang/kill/transient/permanent) fire BEFORE the item
+        is yielded — same failing-index arithmetic — while corruption
+        and the decode_* faults ride the item into the worker stage."""
+        index = start_batch
+        for item in self._inner.ingest_work_items(
+            requests, batch_size, start_batch=start_batch
+        ):
+            self._fire_hook(index)
+            self._maybe_slow(index)
+            self._maybe_hang(index)
+            self._check_faults(index)
+            yield _FaultyIngestItem(self, item)
+            index += 1
+
     def device_scan_chunks(
         self, requests, batch_size: int, start_chunk: int = 0, **kwargs
     ):
@@ -311,3 +365,37 @@ class FaultInjectingDataset:
             self._check_faults(index)
             yield chunk
             index += 1
+
+
+class _FaultyIngestItem:
+    """One wrapped work item: decode-stage faults (worker death,
+    corruption) fire on whichever pool worker runs ``decode()``; the
+    ordered ``commit`` passes through untouched."""
+
+    __slots__ = ("_owner", "_item")
+
+    def __init__(self, owner: FaultInjectingDataset, item: Any):
+        self._owner = owner
+        self._item = item
+
+    @property
+    def index(self) -> int:
+        return self._item.index
+
+    @property
+    def complete(self) -> bool:
+        return self._item.complete
+
+    @property
+    def final(self) -> bool:
+        return self._item.final
+
+    def decode(self):
+        owner = self._owner
+        index = self._item.index
+        owner._check_decode_faults(index)
+        batch = self._item.decode()
+        return owner._maybe_corrupt(index, batch)
+
+    def commit(self, decoded):
+        return self._item.commit(decoded)

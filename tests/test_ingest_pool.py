@@ -1,0 +1,582 @@
+"""Parallel host ingest (engine/ingest.py, docs/PERF.md "r10"): the
+ordered decode/encode worker pool and the process-sharded feed.
+
+The load-bearing assertion is DIFFERENTIAL: ``ingest_workers=1`` runs
+the exact pre-r10 single-prefetcher path, so every metric computed
+with the pool engaged (workers > 1) must equal the workers=1 oracle
+bit-for-bit — on streaming and mesh paths, through mid-stream codec
+widening, dictionary-delta growth, worker-scoped faults, and
+checkpoint/resume. The ordering machinery itself (reassembly,
+lookahead bound, error position, teardown) gets unit scenarios against
+``ordered_ingest`` directly.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    ApproxCountDistinct,
+    Completeness,
+    DataType,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.data.parquet import ParquetDataset
+from deequ_tpu.engine.ingest import (
+    IngestPoolStats,
+    active_ingest_threads,
+    ordered_ingest,
+    resolve_ingest_lookahead,
+    resolve_ingest_workers,
+)
+from deequ_tpu.engine.resilience import RetryPolicy, ScanKilled
+from deequ_tpu.engine.scan import AnalysisEngine, active_prefetch_workers
+from deequ_tpu.io.state_provider import ScanCheckpointer
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.testing.faults import FaultInjectingDataset
+
+FAST_RETRY = RetryPolicy(max_attempts=3, sleep=lambda _s: None)
+
+
+# --------------------------------------------------------------------------
+# ordered_ingest unit scenarios
+# --------------------------------------------------------------------------
+
+
+class TestOrderedIngest:
+    def test_release_order_is_source_order_under_jitter(self):
+        rng = np.random.default_rng(7)
+        delays = rng.uniform(0.0, 0.004, 32).tolist()
+
+        def work(i):
+            time.sleep(delays[i])
+            return i * 10
+
+        out = list(
+            ordered_ingest(
+                range(32), work, workers=4, lookahead=8, emit_event=False
+            )
+        )
+        assert out == [i * 10 for i in range(32)]
+
+    def test_workers_1_is_plain_passthrough(self):
+        out = list(
+            ordered_ingest(
+                range(10), lambda i: i + 1, workers=1, lookahead=2,
+                emit_event=False,
+            )
+        )
+        assert out == list(range(1, 11))
+
+    def test_error_surfaces_at_exact_position(self):
+        def work(i):
+            if i == 5:
+                raise ValueError("boom at five")
+            return i
+
+        received = []
+        with pytest.raises(ValueError, match="boom at five"):
+            for value in ordered_ingest(
+                range(12), work, workers=4, lookahead=6, emit_event=False
+            ):
+                received.append(value)
+        # every earlier item released, nothing after the failure
+        assert received == [0, 1, 2, 3, 4]
+
+    def test_commit_runs_on_consumer_thread_in_order(self):
+        consumer = threading.current_thread()
+        committed = []
+
+        def commit(result, item):
+            assert threading.current_thread() is consumer
+            committed.append(item)
+            return result
+
+        out = list(
+            ordered_ingest(
+                range(16), lambda i: -i, commit, workers=3, lookahead=4,
+                emit_event=False,
+            )
+        )
+        assert committed == list(range(16))
+        assert out == [-i for i in range(16)]
+
+    def test_lookahead_bounds_in_flight_items(self):
+        stats = IngestPoolStats()
+        list(
+            ordered_ingest(
+                range(40), lambda i: i, workers=4, lookahead=5,
+                stats=stats, emit_event=False,
+            )
+        )
+        assert stats.released == 40
+        assert 1 <= stats.peak_in_flight <= 5
+
+    def test_sizer_prices_peak_in_flight_bytes(self):
+        stats = IngestPoolStats()
+        list(
+            ordered_ingest(
+                range(8), lambda i: i, workers=2, lookahead=4,
+                stats=stats, sizer=lambda _r: 1000, emit_event=False,
+            )
+        )
+        assert 1000 <= stats.peak_in_flight_bytes <= 4000
+
+    def test_abandoned_consumer_tears_down_all_threads(self):
+        gen = ordered_ingest(
+            range(1000), lambda i: time.sleep(0.001) or i,
+            workers=4, lookahead=4, emit_event=False,
+        )
+        assert next(gen) == 0
+        gen.close()  # teardown: stop + drain + join
+        deadline = time.time() + 5.0
+        while active_ingest_threads() and time.time() < deadline:
+            time.sleep(0.01)
+        assert active_ingest_threads() == []
+
+    def test_resolvers(self):
+        assert resolve_ingest_workers(3) == 3
+        auto = resolve_ingest_workers(0)
+        assert 1 <= auto <= 4
+        assert resolve_ingest_lookahead(7, workers=2) == 7
+        # auto = 2x workers, floored at workers
+        assert resolve_ingest_lookahead(0, workers=3) == 6
+        assert resolve_ingest_lookahead(1, workers=4) == 4
+
+
+# --------------------------------------------------------------------------
+# engine differentials: workers=1 is the pre-r10 oracle
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pool_parquet(tmp_path_factory):
+    """Four-file parquet source shaped to exercise the pool: floats
+    (one masked), stats-narrowable ints, and a string vocabulary that
+    GROWS per file so dictionary deltas are cut mid-stream."""
+    directory = tmp_path_factory.mktemp("poolpq")
+    rng = np.random.default_rng(29)
+    for i in range(4):
+        n = 900 + i * 150
+        vocab = np.array([f"tok{j:03d}" for j in range((i + 1) * 5)])
+        flat = np.array(["red", "green", "blue"])
+        x = rng.normal(0.0, 1.0, n)
+        pq.write_table(
+            pa.table(
+                {
+                    "f": pa.array(rng.normal(50.0, 9.0, n)),
+                    "x": pa.array(
+                        x, pa.float64(), mask=(rng.random(n) < 0.1)
+                    ),
+                    "k": pa.array(
+                        rng.integers(0, 120, n, dtype=np.int64)
+                    ),
+                    "s": pa.array(vocab[rng.integers(0, len(vocab), n)]),
+                    "t": pa.array(flat[rng.integers(0, 3, n)]),
+                }
+            ),
+            os.path.join(directory, f"part-{i}.parquet"),
+        )
+    return str(directory)
+
+
+POOL_ANALYZERS = [
+    Size(),
+    Mean("f"),
+    Minimum("f"),
+    Maximum("f"),
+    Completeness("x"),
+    Mean("x"),
+    Minimum("k"),
+    Maximum("k"),
+    # TWO string columns each carrying the ACD + DataType pair: the
+    # planner only forms the pooled one-pass codes unit for groups of
+    # >= 2 members (a lone string ACD takes the singles path and pays
+    # the dictionary pre-pass), so this keeps the delta protocol on
+    # the hot path with data_passes == 1
+    ApproxCountDistinct("s"),
+    DataType("s"),
+    ApproxCountDistinct("t"),
+    DataType("t"),
+]
+
+
+def _metric_values(ctx, analyzers=POOL_ANALYZERS):
+    out = []
+    for a in analyzers:
+        value = ctx.metric(a).value
+        assert value.is_success, (a, value)
+        out.append((str(a), value.get()))
+    return out
+
+
+def _run(source, workers, *, engine=None, analyzers=POOL_ANALYZERS,
+         **overrides):
+    overrides.setdefault("device_cache_bytes", 0)
+    overrides.setdefault("batch_size", 512)
+    overrides.setdefault("wire_codecs", True)
+    overrides.setdefault("dict_deltas", True)
+    with config.configure(ingest_workers=workers, **overrides):
+        ctx = AnalysisRunner.do_analysis_run(
+            Dataset.from_parquet(source)
+            if isinstance(source, str)
+            else source,
+            analyzers,
+            engine=engine,
+        )
+    return _metric_values(ctx, analyzers), ctx
+
+
+class TestPoolDifferential:
+    def test_streaming_bit_identity_and_one_pass(self, pool_parquet):
+        tm = get_telemetry()
+
+        def passes(thunk):
+            p0 = tm.counter("engine.data_passes").value
+            out = thunk()
+            return out, tm.counter("engine.data_passes").value - p0
+
+        (ref, _), p1 = passes(lambda: _run(pool_parquet, 1))
+        for workers in (2, 4):
+            (got, _), pn = passes(lambda: _run(pool_parquet, workers))
+            assert got == ref
+            assert pn == p1 == 1
+        assert active_prefetch_workers() == []
+
+    def test_mesh_bit_identity_with_process_sharded_feed(
+        self, pool_parquet, cpu_mesh
+    ):
+        # single-process identity: the process-sharded feed resolves to
+        # make_array_from_process_local_data over the whole batch
+        engine = lambda: AnalysisEngine(mesh=cpu_mesh)  # noqa: E731
+        ref, _ = _run(pool_parquet, 1, engine=engine(), batch_size=512)
+        got, _ = _run(pool_parquet, 4, engine=engine(), batch_size=512)
+        assert got == ref
+        off, _ = _run(
+            pool_parquet, 4, engine=engine(), batch_size=512,
+            process_sharded_ingest=False,
+        )
+        assert off == ref
+
+    def test_resident_path_unaffected(self, pool_parquet):
+        ref, _ = _run(pool_parquet, 1, device_cache_bytes=1 << 30)
+        got, _ = _run(pool_parquet, 4, device_cache_bytes=1 << 30)
+        assert got == ref
+
+    def test_mid_stream_codec_widen_under_concurrency(self, tmp_path):
+        # file 0's stats admit i8 for "k"; file 2 violates mid-stream,
+        # forcing CodecTable.widen while several batches are in flight
+        rng = np.random.default_rng(31)
+        for i, hi in enumerate((90, 90, 30_000)):
+            n = 800
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": pa.array(
+                            rng.integers(0, hi, n, dtype=np.int64)
+                        ),
+                        "f": pa.array(rng.normal(size=n)),
+                    }
+                ),
+                os.path.join(tmp_path, f"part-{i}.parquet"),
+            )
+        analyzers = [Minimum("k"), Maximum("k"), Mean("k"), Mean("f")]
+        ref, _ = _run(
+            str(tmp_path), 1, analyzers=analyzers, batch_size=256
+        )
+        got, _ = _run(
+            str(tmp_path), 4, analyzers=analyzers, batch_size=256
+        )
+        assert got == ref
+
+    def test_dictionary_delta_order_pin(self, pool_parquet):
+        # the growing vocabulary must be discovered in FIRST-OCCURRENCE
+        # order on both paths: compare the cached end-of-stream
+        # dictionaries, not just the metrics (both columns kept so the
+        # pooled delta unit forms and deltas are actually cut)
+        analyzers = [
+            ApproxCountDistinct("s"),
+            DataType("s"),
+            ApproxCountDistinct("t"),
+            DataType("t"),
+        ]
+
+        def dictionary_after(workers):
+            ds = Dataset.from_parquet(pool_parquet)
+            with config.configure(
+                device_cache_bytes=0, batch_size=512,
+                wire_codecs=True, dict_deltas=True,
+                ingest_workers=workers,
+            ):
+                AnalysisRunner.do_analysis_run(ds, analyzers)
+            cached = ds._dictionaries.get("s")
+            return None if cached is None else list(cached)
+
+        d1 = dictionary_after(1)
+        d4 = dictionary_after(4)
+        assert d1 is not None
+        assert d4 == d1
+
+    def test_checkpoint_resume_lands_mid_pool(
+        self, pool_parquet, tmp_path
+    ):
+        tm = get_telemetry()
+        with config.configure(
+            device_cache_bytes=0, batch_size=512,
+            scan_retry=FAST_RETRY, checkpoint_every_batches=2,
+            ingest_workers=4,
+        ):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_parquet(pool_parquet), POOL_ANALYZERS,
+                    engine=AnalysisEngine(),
+                )
+            )
+            ckpt = ScanCheckpointer(str(tmp_path))
+            engine = AnalysisEngine(checkpointer=ckpt)
+            ds = FaultInjectingDataset(
+                Dataset.from_parquet(pool_parquet), kill_at_batch=5
+            )
+            resumes_before = tm.counter("engine.resumes").value
+            with pytest.raises(ScanKilled):
+                AnalysisRunner.do_analysis_run(
+                    ds, POOL_ANALYZERS, engine=engine
+                )
+            assert ckpt._storage.list_keys("scan-ckpt-")
+            ctx = AnalysisRunner.do_analysis_run(
+                ds, POOL_ANALYZERS, engine=engine
+            )
+            assert tm.counter("engine.resumes").value - resumes_before == 1
+        assert _metric_values(ctx) == ref
+        assert active_prefetch_workers() == []
+
+    def test_worker_death_retries_then_matches_oracle(self, pool_parquet):
+        tm = get_telemetry()
+        with config.configure(
+            device_cache_bytes=0, batch_size=512,
+            scan_retry=FAST_RETRY, ingest_workers=4,
+        ):
+            ref = _metric_values(
+                AnalysisRunner.do_analysis_run(
+                    Dataset.from_parquet(pool_parquet), POOL_ANALYZERS
+                )
+            )
+            retries_before = tm.counter("engine.batch_retries").value
+            ds = FaultInjectingDataset(
+                Dataset.from_parquet(pool_parquet),
+                decode_transient={3: 1},
+            )
+            ctx = AnalysisRunner.do_analysis_run(ds, POOL_ANALYZERS)
+        assert ("decode_transient", 3) in ds.faults_fired
+        assert tm.counter("engine.batch_retries").value > retries_before
+        assert _metric_values(ctx) == ref
+
+    def test_permanent_worker_fault_quarantines(self, pool_parquet):
+        tm = get_telemetry()
+        before = tm.counter("engine.batches_quarantined").value
+        ds = FaultInjectingDataset(
+            Dataset.from_parquet(pool_parquet), decode_permanent={2}
+        )
+        with config.configure(
+            device_cache_bytes=0, batch_size=512,
+            scan_retry=FAST_RETRY, ingest_workers=4,
+        ):
+            ctx = AnalysisRunner.do_analysis_run(ds, POOL_ANALYZERS)
+        degr = ctx.degradation
+        assert degr is not None and degr.is_degraded
+        assert degr.batches_quarantined == 1
+        assert tm.counter("engine.batches_quarantined").value - before == 1
+        assert ("decode_permanent", 2) in ds.faults_fired
+        assert active_prefetch_workers() == []
+
+    def test_pool_emits_telemetry_event(self, pool_parquet):
+        with config.configure(
+            device_cache_bytes=0, batch_size=512, ingest_workers=4
+        ):
+            ctx = AnalysisRunner.do_analysis_run(
+                Dataset.from_parquet(pool_parquet), POOL_ANALYZERS
+            )
+        events = [
+            e for e in ctx.run_metadata.events
+            if e.get("event") == "ingest_pool"
+        ]
+        assert events, "pool run must emit an ingest_pool event"
+        assert events[0]["workers"] == 4
+        assert events[0]["released"] > 0
+
+    def test_wrapper_without_declaration_stays_on_legacy_path(
+        self, pool_parquet
+    ):
+        # a plain __getattr__-delegating wrapper does NOT declare
+        # supports_parallel_ingest at class level, so the engine must
+        # not engage the pool through it (dir() gate), yet metrics
+        # still match because the legacy path runs
+        class Opaque:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        ref, _ = _run(pool_parquet, 1)
+        wrapped = Opaque(Dataset.from_parquet(pool_parquet))
+        with config.configure(
+            device_cache_bytes=0, batch_size=512, ingest_workers=4
+        ):
+            ctx = AnalysisRunner.do_analysis_run(wrapped, POOL_ANALYZERS)
+        assert _metric_values(ctx) == ref
+        assert not any(
+            e.get("event") == "ingest_pool"
+            for e in ctx.run_metadata.events
+        )
+
+
+# --------------------------------------------------------------------------
+# planner twin: ingest_work_items replays device_batches exactly
+# --------------------------------------------------------------------------
+
+
+class TestIngestWorkItems:
+    def _requests(self, ds):
+        from deequ_tpu.data.table import ColumnRequest
+
+        return [
+            ColumnRequest("f", "values"),
+            ColumnRequest("x", "values"),
+            ColumnRequest("x", "mask"),
+            ColumnRequest("s", "codes"),
+        ]
+
+    def _drain_items(self, ds, requests, batch_size, start_batch=0):
+        out = []
+        for item in ds.ingest_work_items(
+            requests, batch_size, start_batch=start_batch
+        ):
+            out.append(item.commit(item.decode()))
+        return out
+
+    @staticmethod
+    def _assert_batches_equal(got, want):
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert set(g.keys()) == set(w.keys())
+            for key in w:
+                gv, wv = g[key], w[key]
+                if isinstance(wv, dict):  # dict-delta payloads
+                    assert gv["start"] == wv["start"]
+                    assert list(gv["values"]) == list(wv["values"])
+                else:
+                    np.testing.assert_array_equal(gv, wv)
+
+    def test_batches_bit_equal_to_device_batches(self, pool_parquet):
+        with config.configure(dict_deltas=True):
+            a = ParquetDataset(pool_parquet)
+            b = ParquetDataset(pool_parquet)
+            requests = self._requests(a)
+            want = list(a.device_batches(requests, 512))
+            got = self._drain_items(b, requests, 512)
+        self._assert_batches_equal(got, want)
+        # end-of-stream dictionary caching matches too
+        da, db = a._dictionaries.get("s"), b._dictionaries.get("s")
+        assert da is not None and db is not None
+        assert list(da) == list(db)
+
+    def test_resume_from_start_batch(self, pool_parquet):
+        with config.configure(dict_deltas=True):
+            a = ParquetDataset(pool_parquet)
+            b = ParquetDataset(pool_parquet)
+            requests = self._requests(a)
+            want = list(a.device_batches(requests, 512, start_batch=3))
+            got = self._drain_items(b, requests, 512, start_batch=3)
+        self._assert_batches_equal(got, want)
+
+
+# --------------------------------------------------------------------------
+# process-sharded planner (single-host legs)
+# --------------------------------------------------------------------------
+
+
+class TestShardPlanner:
+    def test_shard_views_cover_disjointly(self, pool_parquet):
+        full = ParquetDataset(pool_parquet)
+        views = [full.shard_view(i, 4) for i in range(4)]
+        assert sum(v.num_rows for v in views) == full.num_rows
+        assert len({v.fingerprint() for v in views}) == 4
+        assert all(
+            v.fingerprint() != full.fingerprint() for v in views
+        )
+
+    def test_shard_row_groups_balance_and_bounds(self, pool_parquet):
+        full = ParquetDataset(pool_parquet)
+        rows = []
+        for i in range(3):
+            frags = full.shard_row_groups(i, 3)
+            rows.append(
+                sum(
+                    int(rg.num_rows)
+                    for f in frags
+                    for rg in f.row_groups
+                )
+            )
+        assert sum(rows) == full.num_rows
+        assert min(rows) > 0  # greedy assignment strands no process
+        with pytest.raises(ValueError):
+            full.shard_row_groups(3, 3)
+        with pytest.raises(ValueError):
+            full.shard_row_groups(-1, 3)
+
+    def test_sharded_union_matches_full_metrics(self, pool_parquet):
+        # scanning each shard and merging states must equal one full
+        # scan: Mean is a monoid, so compare count-weighted sums
+        full = ParquetDataset(pool_parquet)
+        total = 0.0
+        count = 0
+        for i in range(4):
+            view = full.shard_view(i, 4)
+            with config.configure(device_cache_bytes=0, batch_size=512):
+                ctx = AnalysisRunner.do_analysis_run(
+                    view, [Size(), Mean("f")]
+                )
+            n = ctx.metric(Size()).value.get()
+            total += ctx.metric(Mean("f")).value.get() * n
+            count += n
+        with config.configure(device_cache_bytes=0, batch_size=512):
+            ref = AnalysisRunner.do_analysis_run(
+                full, [Size(), Mean("f")]
+            )
+        assert count == ref.metric(Size()).value.get()
+        assert total / count == pytest.approx(
+            ref.metric(Mean("f")).value.get(), rel=1e-12
+        )
+
+
+# --------------------------------------------------------------------------
+# config plumbing
+# --------------------------------------------------------------------------
+
+
+class TestIngestConfig:
+    def test_ingest_depth_reaches_prefetcher(self, pool_parquet):
+        # depth is a host-pipeline knob: any positive value must give
+        # identical metrics (it only changes queue capacity)
+        ref, _ = _run(pool_parquet, 1, ingest_depth=1)
+        got, _ = _run(pool_parquet, 1, ingest_depth=5)
+        assert got == ref
+
+    def test_defaults(self):
+        opts = config.options()
+        assert opts.ingest_depth >= 1
+        assert opts.ingest_workers >= 0
+        assert opts.ingest_lookahead >= 0
+        assert isinstance(opts.process_sharded_ingest, bool)

@@ -890,3 +890,145 @@ class TestObsReport:
         line = render_staticcheck(str(tmp_path))
         assert line.startswith("staticcheck: 1 finding(s)")
         assert "FAILING" in line
+
+
+# --------------------------------------------------------------------------
+# thread-discipline (r10 ingest pool)
+# --------------------------------------------------------------------------
+
+
+THREAD_STRAY = """
+    import threading
+
+    def sketch_in_background(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+"""
+
+THREAD_UNREGISTERED = """
+    import threading
+
+    def spawn_worker(fn):
+        worker = threading.Thread(target=fn, daemon=True)
+        worker.start()
+        return worker
+"""
+
+THREAD_REGISTERED_WRAPPED = """
+    import threading
+
+    from deequ_tpu.engine.ingest import register_ingest_thread
+
+    def spawn_worker(fn):
+        worker = register_ingest_thread(
+            threading.Thread(target=fn, daemon=True)
+        )
+        worker.start()
+        return worker
+"""
+
+THREAD_REGISTERED_BY_NAME = """
+    import threading
+
+    from deequ_tpu.engine.ingest import register_ingest_thread
+
+    class Pool:
+        def spawn(self, fn):
+            self._worker = threading.Thread(target=fn, daemon=True)
+            register_ingest_thread(self._worker)
+            self._worker.start()
+"""
+
+THREAD_WAIVED = """
+    import threading
+
+    def spawn_watchdog(fn):
+        # lint-ok: thread-discipline: joined-with-timeout in stop()
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        return t
+"""
+
+QUEUE_UNBOUNDED = """
+    import queue
+
+    def make_channel():
+        return queue.Queue()
+"""
+
+QUEUE_BOUNDED = """
+    import queue
+
+    def make_channel(depth):
+        return queue.Queue(maxsize=8)
+"""
+
+QUEUE_SIMPLE = """
+    from queue import SimpleQueue
+
+    def make_channel():
+        return SimpleQueue()
+"""
+
+
+class TestThreadDiscipline:
+    SANCTIONED_REL = "deequ_tpu/engine/ingest.py"
+    STRAY_REL = "deequ_tpu/analyzers/fixture.py"
+
+    def test_catches_thread_outside_sanctioned_modules(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, THREAD_STRAY)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "outside the sanctioned" in found[0].message
+
+    def test_silent_when_moved_into_sanctioned_module(self, tmp_path):
+        # the corrected twin: same spawn, but owned by the ingest
+        # module AND registered with the leak probe
+        _write(tmp_path, self.SANCTIONED_REL, THREAD_REGISTERED_WRAPPED)
+        assert _rules_found(tmp_path, "thread-discipline") == []
+
+    def test_catches_unregistered_thread_in_sanctioned_module(
+        self, tmp_path
+    ):
+        _write(tmp_path, self.SANCTIONED_REL, THREAD_UNREGISTERED)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "register_ingest_thread" in found[0].message
+
+    def test_silent_on_registration_via_assigned_name(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, THREAD_REGISTERED_BY_NAME)
+        assert _rules_found(tmp_path, "thread-discipline") == []
+
+    def test_waiver_with_reason_is_honored(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, THREAD_WAIVED)
+        assert _rules_found(tmp_path, "thread-discipline") == []
+        waived = [
+            f
+            for f in run_analyzers(str(tmp_path))
+            if f.rule == "thread-discipline" and f.waived
+        ]
+        assert len(waived) == 1
+        assert waived[0].waive_reason
+
+    def test_catches_unbounded_queue(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, QUEUE_UNBOUNDED)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "maxsize" in found[0].message
+
+    def test_silent_on_bounded_twin(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, QUEUE_BOUNDED)
+        assert _rules_found(tmp_path, "thread-discipline") == []
+
+    def test_simplequeue_always_flagged(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, QUEUE_SIMPLE)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "SimpleQueue"
+
+    def test_queue_outside_sanctioned_modules_flagged(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, QUEUE_BOUNDED)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "outside the sanctioned" in found[0].message

@@ -547,3 +547,84 @@ class TestCorruptWire:
         raw = tm.counter("engine.wire_bytes_raw").value - raw0
         encoded = tm.counter("engine.wire_bytes_encoded").value - enc0
         assert 0 < encoded < raw
+
+
+# --------------------------------------------------------------------------
+# parallel ingest over the codec wire (engine/ingest.py "r10")
+# --------------------------------------------------------------------------
+
+
+class TestParallelIngestDifferential:
+    """The r10 ordered worker pool encodes batches CONCURRENTLY but
+    releases them in source order; every codec behavior above must be
+    invariant under worker count, with workers=1 running the exact
+    pre-pool path as the oracle."""
+
+    def test_codec_wire_is_worker_count_invariant(self, parquet_dir):
+        directory, _ = parquet_dir
+        tm = get_telemetry()
+
+        def wire(workers):
+            raw0 = tm.counter("engine.wire_bytes_raw").value
+            enc0 = tm.counter("engine.wire_bytes_encoded").value
+            vals = _run(
+                Dataset.from_parquet(directory, read_batch_rows=512),
+                True,
+                device_cache_bytes=0,
+                batch_size=450,
+                ingest_workers=workers,
+            )
+            return (
+                vals,
+                tm.counter("engine.wire_bytes_raw").value - raw0,
+                tm.counter("engine.wire_bytes_encoded").value - enc0,
+            )
+
+        ref, raw1, enc1 = wire(1)
+        got, raw4, enc4 = wire(4)
+        assert got == ref
+        # same batches, same codecs -> the same bytes cross the wire
+        assert (raw4, enc4) == (raw1, enc1)
+        assert 0 < enc4 < raw4
+
+    def test_pool_deltas_match_pre_pass_oracle(self, parquet_dir):
+        """Both axes at once: dictionary deltas cut at ordered release
+        under 4 workers vs the pre-pass consts path under 1."""
+        directory, _ = parquet_dir
+        pooled = _run(
+            Dataset.from_parquet(directory, read_batch_rows=512),
+            True,
+            device_cache_bytes=0,
+            batch_size=450,
+            dict_deltas=True,
+            ingest_workers=4,
+        )
+        oracle = _run(
+            Dataset.from_parquet(directory, read_batch_rows=512),
+            True,
+            device_cache_bytes=0,
+            batch_size=450,
+            dict_deltas=False,
+            ingest_workers=1,
+        )
+        assert pooled == oracle
+
+    def test_pool_mesh_matches_oracle(self, parquet_dir, cpu_mesh):
+        directory, _ = parquet_dir
+        got = _run(
+            Dataset.from_parquet(directory, read_batch_rows=512),
+            True,
+            engine=AnalysisEngine(mesh=cpu_mesh),
+            device_cache_bytes=0,
+            batch_size=512,
+            ingest_workers=4,
+        )
+        ref = _run(
+            Dataset.from_parquet(directory, read_batch_rows=512),
+            True,
+            engine=AnalysisEngine(mesh=cpu_mesh),
+            device_cache_bytes=0,
+            batch_size=512,
+            ingest_workers=1,
+        )
+        assert got == ref

@@ -76,6 +76,43 @@ def render_wire_diet(summary: Dict[str, Any]) -> str:
     return "  wire diet: " + ", ".join(parts)
 
 
+def render_ingest_pool(summary: Dict[str, Any]) -> str:
+    """The r10 parallel-ingest line: worker count, per-stage busy
+    fractions of the worker-second budget (decode/encode vs idle), and
+    the reassembly stall — consumer wall spent waiting for the ordered
+    head while later sequence numbers sat finished. Empty string when
+    no scan in the run engaged the pool (workers=1 runs the legacy
+    single prefetcher, which emits no ``ingest_pool`` event)."""
+    pools = [
+        e for e in summary.get("events", [])
+        if e.get("event") == "ingest_pool"
+    ]
+    if not pools:
+        return ""
+    workers = max(int(e.get("workers", 0)) for e in pools)
+    released = sum(int(e.get("released", 0)) for e in pools)
+    wall = sum(float(e.get("wall_s", 0.0)) for e in pools)
+    decode = sum(float(e.get("decode_s", 0.0)) for e in pools)
+    encode = sum(float(e.get("encode_s", 0.0)) for e in pools)
+    idle = sum(float(e.get("idle_s", 0.0)) for e in pools)
+    stall = sum(float(e.get("stall_s", 0.0)) for e in pools)
+    peak_bytes = max(
+        int(e.get("peak_in_flight_bytes", 0)) for e in pools
+    )
+    parts = [f"{workers} worker(s), {released} batch(es)"]
+    budget = wall * max(1, workers)  # worker-seconds available
+    if budget > 0:
+        parts.append(
+            f"busy decode {100.0 * decode / budget:.0f}%"
+            f" / encode {100.0 * encode / budget:.0f}%"
+            f" / idle {100.0 * idle / budget:.0f}%"
+        )
+    parts.append(f"reassembly stall {stall:.3f}s")
+    if peak_bytes > 0:
+        parts.append(f"peak in-flight {_fmt_bytes(peak_bytes)}")
+    return "  ingest pool: " + ", ".join(parts)
+
+
 def render_run(summary: Dict[str, Any]) -> str:
     """One run's breakdown: pass table, wall decomposition, counters."""
     lines = []
@@ -93,6 +130,10 @@ def render_run(summary: Dict[str, Any]) -> str:
     wire_line = render_wire_diet(summary)
     if wire_line:
         lines.append(wire_line)
+
+    pool_line = render_ingest_pool(summary)
+    if pool_line:
+        lines.append(pool_line)
 
     passes = summary.get("passes", [])
     if passes:

@@ -1,0 +1,234 @@
+"""Thread-discipline analyzer: every thread and queue in the product
+tree must be accounted for.
+
+The r10 ingest pool multiplied the number of threads the engine may
+run at once, and the teardown contract ("a cancelled scan leaks no
+thread", asserted via ``active_prefetch_workers() == []`` in tier-1)
+only holds if every ``threading.Thread`` the product constructs is
+visible to the leak probe. One rule, three checks:
+
+``thread-discipline``
+
+1. **Sanctioned modules** — ``threading.Thread`` and ``queue.Queue``
+   constructions in ``deequ_tpu/`` may only appear in the modules that
+   own a documented thread lifecycle (the ingest pool, the legacy
+   prefetcher, the watchdog, and the service layer). A thread spawned
+   from an analyzer or a codec has no owner to join it.
+2. **Leak-probe registration** — each ``Thread`` construction must be
+   passed to :func:`deequ_tpu.engine.ingest.register_ingest_thread`
+   (directly, or via the name/attribute it was assigned to), so
+   ``active_ingest_threads()`` sees it; threads with their own
+   joined-on-stop lifecycle (watchdog, service workers) carry a
+   reasoned ``# lint-ok: thread-discipline:`` waiver instead.
+3. **Bounded queues** — ``queue.Queue()`` must be constructed with a
+   ``maxsize > 0``. An unbounded queue between a fast producer and a
+   stalled consumer buffers the whole dataset on the host;
+   ``SimpleQueue`` is unbounded by construction and always flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+#: modules with a documented thread lifecycle (spawn + join/probe)
+SANCTIONED = frozenset(
+    {
+        "deequ_tpu/engine/deadline.py",
+        "deequ_tpu/engine/ingest.py",
+        "deequ_tpu/engine/scan.py",
+        "deequ_tpu/service/service.py",
+        "deequ_tpu/service/scheduler.py",
+    }
+)
+
+#: functions that make a thread visible to the leak probe
+REGISTRARS = frozenset({"register_ingest_thread"})
+
+#: queue classes that take a maxsize; SimpleQueue never does
+BOUNDED_QUEUE_TAILS = frozenset({"Queue", "LifoQueue", "PriorityQueue"})
+
+
+def _call_tail(node: ast.Call) -> str:
+    return (dotted_name(node.func) or "").split(".")[-1]
+
+
+def _thread_calls(tree: ast.AST, names: Set[str]) -> List[ast.Call]:
+    """Calls constructing ``threading.Thread`` (or a bare ``Thread``
+    imported from threading — ``names`` is the from-import set)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee == "threading.Thread" or (
+            callee == "Thread" and "Thread" in names
+        ):
+            out.append(node)
+    return out
+
+
+def _from_imports(tree: ast.AST, module: str) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            names.update(alias.asname or alias.name for alias in node.names)
+    return names
+
+
+def _queue_maxsize(node: ast.Call) -> Optional[ast.expr]:
+    if node.args:
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            return kw.value
+    return None
+
+
+class ThreadDisciplineAnalyzer(Analyzer):
+    name = "threads"
+    rules = ("thread-discipline",)
+    description = (
+        "threads/queues only in sanctioned modules, registered with "
+        "the ingest leak probe (or waived), queues bounded"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if sf.tree is None or not sf.rel.startswith("deequ_tpu/"):
+                continue
+            yield from self._analyze_file(sf)
+
+    def _analyze_file(self, sf: SourceFile) -> Iterable[Finding]:
+        threading_names = _from_imports(sf.tree, "threading")
+        queue_names = _from_imports(sf.tree, "queue")
+        thread_calls = _thread_calls(sf.tree, threading_names)
+
+        # registration environment: Thread calls that are arguments of
+        # a registrar call, and dotted targets later passed to one
+        wrapped: Set[int] = set()
+        registered_names: Set[str] = set()
+        #: dotted target a Thread call is assigned to, keyed by id()
+        assigned_to: Dict[int, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _call_tail(node) in REGISTRARS:
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call):
+                            wrapped.add(id(sub))
+                        name = dotted_name(sub)
+                        if name:
+                            registered_names.add(name)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = dotted_name(node.targets[0])
+                if target is None:
+                    continue
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        assigned_to.setdefault(id(sub), target)
+
+        for call in thread_calls:
+            if sf.rel not in SANCTIONED:
+                yield Finding(
+                    rule="thread-discipline",
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        "Thread constructed outside the sanctioned "
+                        "threaded modules — no owner joins it on scan "
+                        "teardown; move it into engine/ingest.py, "
+                        "engine/scan.py, engine/deadline.py or the "
+                        "service layer, or waive with a reason"
+                    ),
+                    symbol="Thread",
+                )
+                continue
+            target = assigned_to.get(id(call))
+            registered = id(call) in wrapped or (
+                target is not None and target in registered_names
+            )
+            if not registered:
+                yield Finding(
+                    rule="thread-discipline",
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        "Thread construction not registered with the "
+                        "ingest leak probe (register_ingest_thread) — "
+                        "a leaked thread here is invisible to "
+                        "active_prefetch_workers(); register it or "
+                        "waive with the lifecycle that joins it"
+                    ),
+                    symbol="Thread",
+                )
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func) or ""
+            parts = callee.split(".")
+            tail = parts[-1]
+            is_queue_mod = parts[0] == "queue" and len(parts) == 2
+            is_imported = len(parts) == 1 and tail in queue_names
+            if not (is_queue_mod or is_imported):
+                continue
+            if tail == "SimpleQueue":
+                yield Finding(
+                    rule="thread-discipline",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        "SimpleQueue is unbounded by construction; use "
+                        "queue.Queue(maxsize=<bound>) so a stalled "
+                        "consumer applies backpressure"
+                    ),
+                    symbol="SimpleQueue",
+                )
+                continue
+            if tail not in BOUNDED_QUEUE_TAILS:
+                continue
+            if sf.rel not in SANCTIONED:
+                yield Finding(
+                    rule="thread-discipline",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        "queue constructed outside the sanctioned "
+                        "threaded modules; move it next to the thread "
+                        "lifecycle that drains it, or waive with a "
+                        "reason"
+                    ),
+                    symbol=tail,
+                )
+                continue
+            maxsize = _queue_maxsize(node)
+            unbounded = maxsize is None or (
+                isinstance(maxsize, ast.Constant)
+                and isinstance(maxsize.value, int)
+                and maxsize.value <= 0
+            )
+            if unbounded:
+                yield Finding(
+                    rule="thread-discipline",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        "unbounded queue: construct with maxsize > 0 "
+                        "so the producer blocks instead of buffering "
+                        "the whole dataset on the host"
+                    ),
+                    symbol=tail,
+                )
+
+
+register(ThreadDisciplineAnalyzer())

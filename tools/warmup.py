@@ -199,6 +199,13 @@ def default_engine_variants(schema) -> list:
     # synthetic value ranges do (wide_ints covers both int widths).
     variants.append({"device_cache_bytes": 0})
     variants.append({"device_cache_bytes": 0, "wire_codecs": False})
+    # NO variants for the r10 ingest knobs (ingest_workers /
+    # ingest_depth / ingest_lookahead / process_sharded_ingest): they
+    # are host-pipeline concurrency settings read inside
+    # _run_scan_streaming AFTER prepare_scan, so they are
+    # plan-fingerprint-neutral by construction (the staticcheck
+    # `plankey` gate enforces this) — every worker count reuses the
+    # same warmed plan.
     return variants
 
 
