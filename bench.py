@@ -67,6 +67,103 @@ def _phases(run_metadata):
     )
 
 
+# --------------------------------------------------------------------------
+# Crash-proof harness: host probe, row auto-sizing, subprocess-per-config
+# --------------------------------------------------------------------------
+
+
+def probe_host() -> dict:
+    """What this host can actually sustain: cores, available memory and
+    the jax backend — recorded in the artifact so a round's numbers are
+    interpretable, and fed to :func:`autosize` (ROADMAP item 1: the
+    1-core CI container segfaults ≥1M-row streamed runs that a real
+    host shrugs off)."""
+    probe = {"cpu_count": os.cpu_count() or 1, "mem_available_mb": None}
+    try:
+        with open("/proc/meminfo", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    probe["mem_available_mb"] = int(line.split()[1]) // 1024
+                    break
+    except OSError:
+        pass
+    try:
+        import jax
+
+        probe["jax_backend"] = jax.default_backend()
+        probe["jax_device_count"] = jax.device_count()
+    except Exception as exc:  # noqa: BLE001 — probe must never kill the bench
+        probe["jax_error"] = repr(exc)
+    return probe
+
+
+def autosize(probe: dict) -> dict:
+    """Row sizing for this host. ``$DEEQU_TPU_BENCH_SCALE`` overrides
+    everything; otherwise small (≤2-core) hosts run at 1/4 scale — 1/8
+    under real memory pressure — and streamed configs are additionally
+    capped below the documented ≥1M-row crash threshold, so the bench
+    measures the engine rather than the container's limits."""
+    env = os.environ.get("DEEQU_TPU_BENCH_SCALE", "")
+    cores = probe.get("cpu_count") or 1
+    mem_mb = probe.get("mem_available_mb")
+    if env:
+        scale = max(0.001, float(env))
+    else:
+        scale = 0.25 if cores <= 2 else 1.0
+        if mem_mb is not None and mem_mb < 6_000:
+            scale = min(scale, 0.125 if mem_mb < 3_000 else 0.25)
+    streaming_cap = 800_000 if (cores <= 2 and not env) else None
+    return {"row_scale": scale, "streaming_row_cap": streaming_cap}
+
+
+def _sized(base_rows: int, sizing: dict, streamed: bool = False) -> int:
+    rows = max(100_000, int(base_rows * sizing["row_scale"]))
+    cap = sizing.get("streaming_row_cap") if streamed else None
+    return min(rows, cap) if cap else rows
+
+
+#: config name -> thunk over the sized-args dict. Looked up CHILD-SIDE
+#: by :func:`_bench_child`, so only ``(name, args)`` cross the spawn
+#: pipe — the lambdas themselves are never pickled.
+CONFIG_REGISTRY = {
+    "profiler": lambda a: bench_profiler(a["rows"], a["cols"]),
+    "profiler_50col": lambda a: bench_profiler_wide(a["rows"], 50),
+    "profiler_50col_8m": lambda a: bench_profiler_wide(a["rows"], 50),
+    "fused_bundle_10col": lambda a: bench_fused_bundle(a["rows"]),
+    "grouping_5cat": lambda a: bench_grouping(a["rows"]),
+    "one_pass_spill_grouping": lambda a: bench_one_pass_grouping(a["rows"]),
+    "sketches_hll_kll": lambda a: bench_sketches(a["rows"]),
+    "resilience_overhead": lambda a: bench_resilience_overhead(a["rows"]),
+    "memory_backoff_overhead": (
+        lambda a: bench_memory_backoff_overhead(a["rows"])
+    ),
+    "watchdog_overhead": lambda a: bench_watchdog_overhead(a["rows"]),
+    "service_concurrent_suites": (
+        lambda a: bench_service_concurrent_suites(a["rows"], a["clients"])
+    ),
+    "spill_grouping_12M_distinct": lambda a: bench_spill_grouping(a["rows"]),
+    "joint_grouping_mi_1Mcard_pair": lambda a: bench_joint_grouping(a["rows"]),
+    "streaming_parquet": (
+        lambda a: bench_streaming_parquet(a["rows"], a["cols"])
+    ),
+    "streaming_wire_diet": lambda a: bench_streaming_wire_diet(a["rows"]),
+    "streaming_ingest_parallel": (
+        lambda a: bench_streaming_ingest_parallel(a["rows"], a["cols"])
+    ),
+    "streaming_bundle_100m": lambda a: bench_streaming_bundle_100m(a["rows"]),
+}
+
+
+def _bench_child(payload: dict):
+    """``IsolatedRunner`` child entry: run ONE config and ship its
+    detail dict back over the pipe. Each config is self-warming, so a
+    fresh process per config pays only the import+compile it already
+    paid — and a SIGSEGV in one config can no longer take out the
+    artifact: its status lands in the JSON and the next config runs in
+    a clean process."""
+    return CONFIG_REGISTRY[payload["name"]](payload["args"])
+
+
 def _tpcds_like(num_rows: int, num_cols: int, seed: int):
     """A store_sales-shaped synthetic table: ~60% numeric measures,
     ~20% integral keys, ~20% low-cardinality categorical strings."""
@@ -1394,6 +1491,14 @@ def main(argv=None):
         help="also write the full detail JSON (the stderr document) "
         "to this path",
     )
+    parser.add_argument(
+        "--inline",
+        action="store_true",
+        default=os.environ.get("DEEQU_TPU_BENCH_INLINE", "0") == "1",
+        help="run configs in-process instead of subprocess-per-config "
+        "(debugging only: one SIGSEGV then kills the whole bench); "
+        "also $DEEQU_TPU_BENCH_INLINE=1",
+    )
     args = parser.parse_args(argv)
     wanted = {
         name.strip() for name in args.configs.split(",") if name.strip()
@@ -1404,16 +1509,92 @@ def main(argv=None):
     def remaining() -> float:
         return args.budget - (time.time() - start)
 
-    # scaled to one chip: 4M rows x 20 cols for the headline profiler run
-    prof_rows, prof_cols = (
-        (500_000, 20) if args.quick else (4_000_000, 20)
+    # what can THIS host sustain? probe first, size everything from it
+    host = probe_host()
+    sizing = autosize(host)
+    scale = sizing["row_scale"]
+    print(
+        f"[bench] host: {host.get('cpu_count')} cores, "
+        f"{host.get('mem_available_mb')} MB available, "
+        f"backend={host.get('jax_backend', '?')} "
+        f"x{host.get('jax_device_count', '?')}; row scale {scale}"
+        + (
+            f", streamed rows capped at {sizing['streaming_row_cap']}"
+            if sizing["streaming_row_cap"]
+            else ""
+        ),
+        file=sys.stderr,
+        flush=True,
     )
-    detail = {"budget_s": args.budget, "quick": args.quick, "skipped": []}
-    if not wanted or "profiler" in wanted:
+
+    # scaled to one chip: 4M rows x 20 cols for the headline profiler
+    # run at scale 1.0, auto-sized down on small hosts
+    prof_rows = _sized(500_000 if args.quick else 4_000_000, sizing)
+    prof_cols = 20
+    detail = {
+        "budget_s": args.budget,
+        "quick": args.quick,
+        "isolated": not args.inline,
+        "host": host,
+        "sizing": sizing,
+        "skipped": [],
+        "config_status": {},
+    }
+
+    def run_one(name: str, cfg_args: dict, est_s: float) -> dict:
+        """ONE config through a spawn-started child (crash isolation:
+        a config that segfaults or stalls becomes a status entry, not
+        the end of the bench). Fills detail[name] on success and
+        detail["config_status"][name] always."""
+        status = {"rows": cfg_args.get("rows"), "estimated_s": est_s}
+        t0 = time.time()
+        payload = {"name": name, "args": cfg_args}
         try:
-            detail["profiler"] = bench_profiler(prof_rows, prof_cols)
-        except Exception as exc:  # headline failure must not kill the line
-            detail["error"] = repr(exc)
+            if args.inline:
+                detail[name] = _bench_child(payload)
+            else:
+                from deequ_tpu.engine.subproc import IsolatedRunner
+
+                runner = IsolatedRunner(
+                    key=f"bench:{name}",
+                    # bench configs are not checkpointer-resumable, so
+                    # one crash = one failed config, no relaunch
+                    max_relaunches=1,
+                    use_breaker=False,
+                    timeout_s=max(120.0, min(remaining(), est_s * 3.0)),
+                )
+                detail[name] = runner.run(_bench_child, payload)
+            status["status"] = "ok"
+        except BaseException as exc:  # noqa: BLE001 — a status, never a crash
+            sig = getattr(exc, "last_signal", None) or getattr(
+                exc, "signal_name", None
+            )
+            rc = getattr(exc, "last_exitcode", None)
+            if rc is None:
+                rc = getattr(exc, "exitcode", None)
+            if sig == "timeout":
+                status["status"] = "timeout"
+            elif sig is not None or rc is not None:
+                status["status"] = "crashed"
+            else:
+                status["status"] = "error"
+            status["error"] = repr(exc)
+            if sig is not None:
+                status["signal"] = sig
+            if rc is not None:
+                status["exitcode"] = rc
+            detail.setdefault("errors", {})[name] = repr(exc)
+        status["wall_s"] = round(time.time() - t0, 1)
+        detail["config_status"][name] = status
+        detail.setdefault("config_walls", {})[name] = status["wall_s"]
+        return status
+
+    if not wanted or "profiler" in wanted:
+        st = run_one(
+            "profiler", {"rows": prof_rows, "cols": prof_cols}, 300
+        )
+        if st["status"] != "ok":
+            detail["error"] = st.get("error", "headline config failed")
 
     def headline_line() -> dict:
         prof = detail.get("profiler")
@@ -1459,10 +1640,12 @@ def main(argv=None):
         flush=True,
     )
 
-    # (name, thunk, estimated cost in seconds) — an estimate is the
-    # gate: a config only starts when the remaining budget covers it,
-    # so the overall wall stays under --budget instead of rc=124-ing
-    # the harness (BENCH_r05)
+    # (name, base args, streamed?, estimated cost in seconds at scale
+    # 1.0) — the estimate is the gate: a config only starts when the
+    # remaining budget covers it, so the overall wall stays under
+    # --budget instead of rc=124-ing the harness (BENCH_r05). Rows are
+    # auto-sized per host before launch; streamed configs additionally
+    # respect the streaming row cap.
     # ORDER MATTERS (r6): the two wide-profiler configs run FIRST so
     # the cell-rate headline fields (ns_per_cell_50col,
     # projected_1b_x50_resident_8chip_s) exist even when the harness
@@ -1473,48 +1656,43 @@ def main(argv=None):
         []
         if args.quick
         else [
-            ("profiler_50col",
-             lambda: bench_profiler_wide(4_000_000, 50), 150),
-            ("profiler_50col_8m",
-             lambda: bench_profiler_wide(8_000_000, 50), 200),
-            ("fused_bundle_10col",
-             lambda: bench_fused_bundle(8_000_000), 60),
-            ("grouping_5cat", lambda: bench_grouping(4_000_000), 60),
-            ("one_pass_spill_grouping",
-             lambda: bench_one_pass_grouping(4_000_000), 100),
-            ("sketches_hll_kll", lambda: bench_sketches(8_000_000), 60),
-            ("resilience_overhead",
-             lambda: bench_resilience_overhead(4_000_000), 90),
-            ("memory_backoff_overhead",
-             lambda: bench_memory_backoff_overhead(4_000_000), 90),
-            ("watchdog_overhead",
-             lambda: bench_watchdog_overhead(4_000_000), 90),
-            ("service_concurrent_suites",
-             lambda: bench_service_concurrent_suites(2_000_000, 8), 90),
-            ("spill_grouping_12M_distinct",
-             lambda: bench_spill_grouping(12_000_000), 120),
-            ("joint_grouping_mi_1Mcard_pair",
-             lambda: bench_joint_grouping(4_000_000), 120),
-            ("streaming_parquet",
-             # est = worst observed (BENCH_r03 hit 386s on a degraded
-             # link), not the 8s a healthy link delivers — gating on
-             # the median is how r05 overran its budget
-             lambda: bench_streaming_parquet(4_000_000, 10), 390),
-            ("streaming_wire_diet",
-             # two streamed passes over the same 4M-row table (codecs
-             # on, then off); budget sized like streaming_parquet's
-             # worst observed link, not its healthy-link median
-             lambda: bench_streaming_wire_diet(4_000_000), 390),
-            ("streaming_ingest_parallel",
-             # three streamed passes over the same 4M-row table
-             # (workers 1/2/4, each with a warm run); sized like the
-             # other streaming configs' worst observed link
-             lambda: bench_streaming_ingest_parallel(4_000_000, 10),
-             400),
-            ("streaming_bundle_100m",
-             lambda: bench_streaming_bundle_100m(), 330),
+            ("profiler_50col", {"rows": 4_000_000}, False, 150),
+            ("profiler_50col_8m", {"rows": 8_000_000}, False, 200),
+            ("fused_bundle_10col", {"rows": 8_000_000}, False, 60),
+            ("grouping_5cat", {"rows": 4_000_000}, False, 60),
+            ("one_pass_spill_grouping", {"rows": 4_000_000}, False, 100),
+            ("sketches_hll_kll", {"rows": 8_000_000}, False, 60),
+            ("resilience_overhead", {"rows": 4_000_000}, False, 90),
+            ("memory_backoff_overhead", {"rows": 4_000_000}, False, 90),
+            ("watchdog_overhead", {"rows": 4_000_000}, False, 90),
+            (
+                "service_concurrent_suites",
+                {"rows": 2_000_000, "clients": 8},
+                False,
+                90,
+            ),
+            ("spill_grouping_12M_distinct", {"rows": 12_000_000}, False, 120),
+            (
+                "joint_grouping_mi_1Mcard_pair",
+                {"rows": 4_000_000},
+                False,
+                120,
+            ),
+            # streaming ests = worst observed link (BENCH_r03 hit 386s
+            # on a degraded tunnel), not the healthy-link median —
+            # gating on the median is how r05 overran its budget
+            ("streaming_parquet", {"rows": 4_000_000, "cols": 10}, True, 390),
+            ("streaming_wire_diet", {"rows": 4_000_000}, True, 390),
+            (
+                "streaming_ingest_parallel",
+                {"rows": 4_000_000, "cols": 10},
+                True,
+                400,
+            ),
+            ("streaming_bundle_100m", {"rows": 100_000_000}, True, 330),
         ]
     )
+
     def merge_wide(result: dict) -> dict:
         # the 50-col cell-rate headline (VERDICT r4) plus the r6 8M
         # scaling check: resident rate on the north-star-shaped config
@@ -1542,64 +1720,93 @@ def main(argv=None):
             )
         return result
 
-    for name, thunk, est_s in secondary:
-        if wanted and name not in wanted:
-            continue
-        if remaining() < est_s:
-            detail["skipped"].append(
-                {
-                    "config": name,
-                    "estimated_s": est_s,
+    try:
+        for name, base_args, streamed, est_s in secondary:
+            if wanted and name not in wanted:
+                continue
+            # a scaled-down config finishes faster; the +20s covers the
+            # child's own import+compile on top of the scaled run
+            est_eff = (
+                est_s
+                if scale >= 1.0
+                else max(45, int(est_s * scale) + 20)
+            )
+            if remaining() < est_eff:
+                detail["skipped"].append(
+                    {
+                        "config": name,
+                        "estimated_s": est_eff,
+                        "remaining_s": round(remaining(), 1),
+                    }
+                )
+                detail["config_status"][name] = {
+                    "status": "skipped",
+                    "estimated_s": est_eff,
                     "remaining_s": round(remaining(), 1),
                 }
-            )
+                print(
+                    f"[bench] SKIPPED {name} (est {est_eff}s > "
+                    f"{remaining():.0f}s remaining)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                continue
+            cfg = dict(base_args)
+            cfg["rows"] = _sized(base_args["rows"], sizing, streamed)
             print(
-                f"[bench] SKIPPED {name} (est {est_s}s > "
-                f"{remaining():.0f}s remaining)",
+                f"[bench] running {name} ({cfg['rows']} rows)...",
                 file=sys.stderr,
                 flush=True,
             )
-            continue
-        print(f"[bench] running {name}...", file=sys.stderr, flush=True)
-        t0 = time.time()
-        try:
-            detail[name] = thunk()
-        except Exception as exc:  # secondary configs must not kill the line
-            detail.setdefault("errors", {})[name] = repr(exc)
-        wall = round(time.time() - t0, 1)
-        detail.setdefault("config_walls", {})[name] = wall
-        print(
-            f"[bench] {name}: {wall}s "
-            f"({remaining():.0f}s of budget left)",
-            file=sys.stderr,
-            flush=True,
-        )
-        if name in ("profiler_50col", "profiler_50col_8m"):
-            # re-emit the preliminary line the moment a wide config
-            # lands: the cell-rate/projection fields survive an rc=124
-            # kill during the remaining (slower) tail configs
+            st = run_one(name, cfg, est_eff)
             print(
-                json.dumps(
-                    {**merge_wide(headline_line()), "preliminary": True}
-                ),
+                f"[bench] {name}: {st['status']} in {st['wall_s']}s "
+                f"({remaining():.0f}s of budget left)",
+                file=sys.stderr,
                 flush=True,
             )
+            if name in ("profiler_50col", "profiler_50col_8m"):
+                # re-emit the preliminary line the moment a wide config
+                # lands: the cell-rate/projection fields survive an
+                # rc=124 kill during the remaining (slower) tail configs
+                print(
+                    json.dumps(
+                        {**merge_wide(headline_line()), "preliminary": True}
+                    ),
+                    flush=True,
+                )
+    finally:
+        # the artifact and the headline line ALWAYS emit, complete with
+        # per-config status, whatever the configs did — partial results
+        # with provenance beat a dead harness (rc stays 0)
+        from deequ_tpu.telemetry import get_telemetry
 
-    # the process-wide telemetry picture of everything the bench ran:
-    # counter totals + the pass-latency histogram (docs/OBSERVABILITY.md)
-    from deequ_tpu.telemetry import get_telemetry
+        # the process-wide telemetry picture of everything the bench
+        # ran: counter totals + the pass-latency histogram
+        # (docs/OBSERVABILITY.md); children's counters/events were
+        # merged in by IsolatedRunner as each config completed
+        try:
+            detail["telemetry"] = get_telemetry().metrics.snapshot()
+        except Exception as exc:  # noqa: BLE001
+            detail["telemetry_error"] = repr(exc)
+        detail["total_wall_s"] = round(time.time() - start, 1)
 
-    detail["telemetry"] = get_telemetry().metrics.snapshot()
-    detail["total_wall_s"] = round(time.time() - start, 1)
-
-    result = merge_wide(headline_line())
-    print(json.dumps(detail, indent=2), file=sys.stderr)
-    if args.artifact:
-        with open(args.artifact, "w", encoding="utf-8") as fh:
-            json.dump(detail, fh, indent=2)
-            fh.write("\n")
-    print(json.dumps(result))
+        result = merge_wide(headline_line())
+        print(json.dumps(detail, indent=2, default=str), file=sys.stderr)
+        if args.artifact:
+            try:
+                with open(args.artifact, "w", encoding="utf-8") as fh:
+                    json.dump(detail, fh, indent=2, default=str)
+                    fh.write("\n")
+            except OSError as exc:
+                print(
+                    f"[bench] artifact write failed: {exc!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        print(json.dumps(result, default=str))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -243,6 +243,45 @@ class Options:
     service_tenant_max_active: int = int(
         os.environ.get("DEEQU_TPU_SERVICE_TENANT_MAX_ACTIVE", 0) or 0
     )
+    # crash isolation (engine/subproc.py, docs/RESILIENCE.md "Crash
+    # isolation and recovery"): run service executions in a
+    # spawn-started child process so a hard crash (SIGSEGV/OOM-kill)
+    # costs one checkpoint window, not the daemon
+    isolated_execution: bool = (
+        os.environ.get("DEEQU_TPU_ISOLATED_EXECUTION", "0") == "1"
+    )
+    # child relaunches WITHOUT checkpoint progress before the run is
+    # declared a crash loop (the poison-batch bound); each relaunch
+    # that advanced the cursor resets the count
+    crash_max_relaunches: int = int(
+        os.environ.get("DEEQU_TPU_CRASH_MAX_RELAUNCHES", 3)
+    )
+    # per-plan crash-loop circuit breaker: seconds the breaker stays
+    # OPEN (rejecting launches fast) before one half-open probe launch
+    # is allowed through; <= 0 disables the breaker entirely
+    crash_breaker_cooldown_s: float = float(
+        os.environ.get("DEEQU_TPU_CRASH_BREAKER_COOLDOWN", 30.0)
+    )
+    # durable write-ahead run journal directory (service/journal.py);
+    # "" disables journaling (and with it restart recovery)
+    service_journal_dir: str = os.environ.get(
+        "DEEQU_TPU_SERVICE_JOURNAL_DIR", ""
+    )
+    # load shedding at the submission edge: BATCH-priority submits are
+    # rejected fast (ServiceOverloaded, with a retry-after hint) once
+    # the queue holds this many runs; 0 disables
+    service_shed_queue_depth: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_SHED_QUEUE_DEPTH", 0) or 0
+    )
+    # ... and once this many child crashes landed inside the sliding
+    # crash-rate window (service-wide, any plan); 0 disables
+    service_shed_crash_rate: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_SHED_CRASH_RATE", 0) or 0
+    )
+    # sliding-window length (seconds) for the crash-rate shed signal
+    service_shed_crash_window_s: float = float(
+        os.environ.get("DEEQU_TPU_SERVICE_SHED_CRASH_WINDOW", 60.0)
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -8,15 +8,31 @@ from deequ_tpu.engine.deadline import (
     install_graceful_shutdown,
 )
 from deequ_tpu.engine.scan import AnalysisEngine, monoid_all_reduce
+from deequ_tpu.engine.subproc import (
+    BreakerOpen,
+    CircuitBreaker,
+    CrashLoopError,
+    IsolatedRunner,
+    ProcessCrashed,
+    checkpoint_progress_probe,
+    run_isolated,
+)
 
 __all__ = [
     "AnalysisEngine",
+    "BreakerOpen",
     "CancelToken",
+    "CircuitBreaker",
+    "CrashLoopError",
     "DeadlineExceeded",
+    "IsolatedRunner",
+    "ProcessCrashed",
     "RunBudget",
     "RunCancelled",
     "ScanInterrupted",
     "ScanInterruption",
+    "checkpoint_progress_probe",
     "install_graceful_shutdown",
     "monoid_all_reduce",
+    "run_isolated",
 ]

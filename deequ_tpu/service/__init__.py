@@ -29,6 +29,7 @@ lint-enforced).
 """
 
 from deequ_tpu.service.caches import DatasetCache, PlanCache
+from deequ_tpu.service.journal import RunJournal
 from deequ_tpu.service.queue import (
     Priority,
     QuotaExceeded,
@@ -38,7 +39,11 @@ from deequ_tpu.service.queue import (
     RunTicket,
 )
 from deequ_tpu.service.scheduler import Scheduler
-from deequ_tpu.service.service import RunRequest, VerificationService
+from deequ_tpu.service.service import (
+    RunRequest,
+    ServiceOverloaded,
+    VerificationService,
+)
 
 __all__ = [
     "DatasetCache",
@@ -46,10 +51,12 @@ __all__ = [
     "Priority",
     "QuotaExceeded",
     "RunHandle",
+    "RunJournal",
     "RunQueue",
     "RunState",
     "RunTicket",
     "RunRequest",
     "Scheduler",
+    "ServiceOverloaded",
     "VerificationService",
 ]

@@ -1,0 +1,216 @@
+"""Durable write-ahead run journal: the service's restart story.
+
+The queue and every ``RunHandle`` live in process memory — a SIGKILLed
+daemon forgets every accepted run. The journal fixes that at the edge:
+``submit`` appends a durable record BEFORE the ticket enters the queue
+(write-ahead ordering), every lifecycle transition appends another, and
+``VerificationService.recover()`` on a fresh process replays the log to
+re-admit everything that never reached a terminal state. Scan POSITION
+is not the journal's job — ``ScanCheckpointer`` cursors already persist
+durably per plan token, so a re-admitted run resumes mid-scan for free.
+
+Format: one record per blob under the journal directory (any
+``io/storage.py`` backend — plain paths, ``file://``, ``mem://``),
+keyed ``runlog-{seq:010d}.rec`` so lexicographic order IS append order.
+Each blob is ``crc32-hex + "\\n" + json-body`` and is written with
+``write_bytes(durable=True)`` (fsync + dir fsync on LocalStorage). A
+record that fails the CRC or does not parse marks the torn tail of the
+log: replay stops there — the records after a corruption have no
+ordering guarantee — and the loss is bounded to transitions not yet
+acknowledged, exactly a truncation.
+
+Timing discipline: the journal never reads a clock. Anything temporal
+in a record (deadline remaining, queue wait) is computed by the caller
+on ITS injected clock and passed in as plain data — monotonic
+timestamps would be meaningless across the process restart the journal
+exists to survive.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import zlib
+from typing import Any, Dict, Iterator, List, Optional
+
+KEY_PREFIX = "runlog-"
+KEY_SUFFIX = ".rec"
+
+#: lifecycle transitions a record may carry
+RECORD_TYPES = ("submitted", "started", "checkpoint", "terminal")
+
+
+def _encode(body: Dict[str, Any]) -> bytes:
+    payload = json.dumps(body, sort_keys=True).encode()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return f"{crc:08x}\n".encode() + payload
+
+
+def _decode(blob: bytes) -> Optional[Dict[str, Any]]:
+    """The record body, or None for a torn/corrupt blob."""
+    try:
+        header, payload = blob.split(b"\n", 1)
+        if int(header, 16) != (zlib.crc32(payload) & 0xFFFFFFFF):
+            return None
+        body = json.loads(payload)
+    except Exception:  # noqa: BLE001 — any malformation = torn record
+        return None
+    return body if isinstance(body, dict) else None
+
+
+class RunJournal:
+    """Append-only durable journal over a storage backend. Thread-safe;
+    one instance per service. Sequence numbers continue from whatever
+    the directory already holds, so a recovered service appends to the
+    same log it replays."""
+
+    def __init__(self, path: str):
+        from deequ_tpu.io.storage import storage_for
+
+        self._path = path
+        self._storage = storage_for(path)
+        self._lock = threading.Lock()
+        self._seq = self._scan_top_seq()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _scan_top_seq(self) -> int:
+        top = 0
+        for key in self._storage.list_keys(KEY_PREFIX):
+            digits = key[len(KEY_PREFIX):].split(".", 1)[0]
+            try:
+                top = max(top, int(digits))
+            except ValueError:
+                continue
+        return top
+
+    @staticmethod
+    def _key(seq: int) -> str:
+        return f"{KEY_PREFIX}{seq:010d}{KEY_SUFFIX}"
+
+    # -- append side ------------------------------------------------------
+
+    def append(self, record_type: str, run_id: str, **fields: Any) -> int:
+        """Durably append one transition; returns its sequence number.
+        ``fields`` must be JSON-safe (the caller owns that — exceptions
+        are reduced to strings at the call site)."""
+        if record_type not in RECORD_TYPES:
+            raise ValueError(f"unknown journal record type {record_type!r}")
+        body = {"type": record_type, "run_id": run_id, **fields}
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            body["seq"] = seq
+            blob = _encode(body)
+            key = self._key(seq)
+            try:
+                self._storage.write_bytes(key, blob, durable=True)
+            except TypeError:  # pre-``durable=`` Storage subclass
+                self._storage.write_bytes(key, blob)
+        return seq
+
+    def record_submitted(self, run_id: str, **fields: Any) -> int:
+        return self.append("submitted", run_id, **fields)
+
+    def record_started(self, run_id: str, **fields: Any) -> int:
+        return self.append("started", run_id, **fields)
+
+    def record_checkpoint(self, run_id: str, **fields: Any) -> int:
+        return self.append("checkpoint", run_id, **fields)
+
+    def record_terminal(self, run_id: str, state: str, **fields: Any) -> int:
+        return self.append("terminal", run_id, state=state, **fields)
+
+    # -- replay side ------------------------------------------------------
+
+    def replay(self) -> List[Dict[str, Any]]:
+        """Records in append order, stopping at the first torn/corrupt
+        blob (truncation semantics: nothing after a corruption is
+        trusted). Missing blobs likewise end the log."""
+        out: List[Dict[str, Any]] = []
+        for seq in self._ordered_seqs():
+            raw = self._storage.read_bytes(self._key(seq))
+            body = _decode(raw) if raw is not None else None
+            if body is None:
+                from deequ_tpu.telemetry import get_telemetry
+
+                get_telemetry().event(
+                    "journal_truncated", path=self._path, at_seq=seq
+                )
+                break
+            out.append(body)
+        return out
+
+    def _ordered_seqs(self) -> Iterator[int]:
+        seqs = []
+        for key in self._storage.list_keys(KEY_PREFIX):
+            digits = key[len(KEY_PREFIX):].split(".", 1)[0]
+            try:
+                seqs.append(int(digits))
+            except ValueError:
+                continue
+        return iter(sorted(seqs))
+
+    def pending_runs(self) -> Dict[str, Dict[str, Any]]:
+        """run_id -> state for every journaled run WITHOUT a terminal
+        record, in submit order: the submitted record's fields plus
+        ``started`` (bool) and ``last_checkpoint`` (fields of the latest
+        checkpoint record, or None)."""
+        pending: Dict[str, Dict[str, Any]] = {}
+        for record in self.replay():
+            run_id = record.get("run_id")
+            rtype = record.get("type")
+            if not run_id:
+                continue
+            if rtype == "submitted":
+                entry = {
+                    k: v
+                    for k, v in record.items()
+                    if k not in ("type", "seq")
+                }
+                entry["started"] = False
+                entry["last_checkpoint"] = None
+                pending[run_id] = entry
+            elif run_id in pending:
+                if rtype == "started":
+                    pending[run_id]["started"] = True
+                elif rtype == "checkpoint":
+                    pending[run_id]["last_checkpoint"] = {
+                        k: v
+                        for k, v in record.items()
+                        if k not in ("type", "seq", "run_id")
+                    }
+                elif rtype == "terminal":
+                    del pending[run_id]
+        return pending
+
+    # -- maintenance ------------------------------------------------------
+
+    def compact(self) -> int:
+        """Drop the records of runs that reached a terminal state
+        (their story is over; replay does not need them). Returns how
+        many records were deleted. Corrupt-tail blobs are also dropped —
+        after a replayed recovery they are dead weight."""
+        records = self.replay()
+        terminal = {
+            r["run_id"]
+            for r in records
+            if r.get("type") == "terminal" and r.get("run_id")
+        }
+        live_seqs = {
+            r["seq"]
+            for r in records
+            if r.get("run_id") not in terminal and "seq" in r
+        }
+        removed = 0
+        with self._lock:
+            for seq in list(self._ordered_seqs()):
+                if seq not in live_seqs:
+                    self._storage.delete(self._key(seq))
+                    removed += 1
+        return removed
+
+    def __repr__(self) -> str:
+        return f"RunJournal({self._path!r})"

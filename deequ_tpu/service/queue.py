@@ -96,6 +96,11 @@ class RunHandle:
         self.submitted_at: Optional[float] = None
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # fired exactly once, after the terminal transition publishes
+        # (the service's journal hook rides here, so EVERY terminal
+        # path — scheduler finish, queued-state rejection, drain —
+        # reaches the write-ahead log)
+        self.on_terminal: Optional[Callable[["RunHandle"], None]] = None
 
     @property
     def status(self) -> str:
@@ -136,6 +141,14 @@ class RunHandle:
         # lint-ok: lock-discipline: post-Event read, see above
         return self._result
 
+    def terminal_info(self):
+        """(state, error) once terminal, ``(None, None)`` before — the
+        journal hook's read API (no private attribute pokes)."""
+        with self._lock:
+            if self._state not in RunState.TERMINAL:
+                return None, None
+            return self._state, self._error
+
     # -- transitions (scheduler/queue internal) -------------------------
 
     def _mark_running(self) -> None:
@@ -156,6 +169,12 @@ class RunHandle:
             self._result = result
             self._error = error
         self._done.set()
+        hook = self.on_terminal
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:  # noqa: BLE001 — journaling must never
+                pass  # turn a finished run into a crashed worker
 
     def __repr__(self) -> str:
         return (

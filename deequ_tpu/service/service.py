@@ -28,7 +28,8 @@ same contract as a direct bounded run.
 
 from __future__ import annotations
 
-import itertools
+import collections
+import pickle
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -38,15 +39,31 @@ from deequ_tpu.engine.deadline import (
     RunBudget,
     shutdown_token,
 )
+from deequ_tpu.engine.subproc import CrashLoopError, IsolatedRunner
+from deequ_tpu.io.state_provider import ScanCheckpointer
 from deequ_tpu.service.caches import DatasetCache, PlanCache
+from deequ_tpu.service.journal import RunJournal
 from deequ_tpu.service.queue import (
     Priority,
+    QuotaExceeded,
     RunHandle,
     RunQueue,
+    RunState,
     RunTicket,
 )
 from deequ_tpu.service.scheduler import Scheduler
 from deequ_tpu.telemetry import get_telemetry
+
+
+class ServiceOverloaded(RuntimeError):
+    """A BATCH submission was shed at the edge (queue depth or crash
+    rate over the ``service_shed_*`` thresholds). ``retry_after_s`` is
+    the caller's resubmission hint — failing FAST with a hint beats
+    accepting work that will deadline-expire silently in the queue."""
+
+    def __init__(self, message: str, *, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = max(0.0, float(retry_after_s))
 
 
 @dataclass
@@ -95,11 +112,47 @@ class VerificationService:
         tenant_max_pending: Optional[int] = None,
         tenant_max_active: Optional[int] = None,
         execute: Optional[Callable[[RunTicket], Any]] = None,
+        journal_dir: Optional[str] = None,
+        isolated: Optional[bool] = None,
+        shed_queue_depth: Optional[int] = None,
+        shed_crash_rate: Optional[int] = None,
+        shed_crash_window_s: Optional[float] = None,
     ):
         from deequ_tpu import config
 
         opts = config.options()
         self.clock = clock or MonotonicClock()
+        journal_dir = (
+            journal_dir
+            if journal_dir is not None
+            else opts.service_journal_dir
+        )
+        self.journal: Optional[RunJournal] = (
+            RunJournal(journal_dir) if journal_dir else None
+        )
+        self._checkpoint_path: Optional[str] = (
+            journal_dir.rstrip("/") + "/checkpoints" if journal_dir else None
+        )
+        self.isolated = (
+            bool(opts.isolated_execution) if isolated is None else bool(isolated)
+        )
+        self.shed_queue_depth = int(
+            opts.service_shed_queue_depth
+            if shed_queue_depth is None
+            else shed_queue_depth
+        )
+        self.shed_crash_rate = int(
+            opts.service_shed_crash_rate
+            if shed_crash_rate is None
+            else shed_crash_rate
+        )
+        self.shed_crash_window_s = float(
+            opts.service_shed_crash_window_s
+            if shed_crash_window_s is None
+            else shed_crash_window_s
+        )
+        self._crash_times: collections.deque = collections.deque()
+        self._crash_lock = threading.Lock()
         watermark = (
             dataset_watermark_bytes
             if dataset_watermark_bytes is not None
@@ -136,7 +189,7 @@ class VerificationService:
             ),
             clock=self.clock,
         )
-        self._run_ids = itertools.count(1)
+        self._run_seq = 0
         self._handles: Dict[str, RunHandle] = {}
         self._handles_lock = threading.Lock()
         self._uninstall_sigterm: Optional[Callable[[], None]] = None
@@ -230,9 +283,22 @@ class VerificationService:
     def submit(self, request: RunRequest) -> RunHandle:
         """Queue one suite run; returns immediately with the handle.
         Raises ``QuotaExceeded`` when the tenant is over its pending
-        quota. The deadline budget starts NOW — time spent queued
-        counts against it."""
-        run_id = f"run-{next(self._run_ids)}"
+        quota and ``ServiceOverloaded`` when a BATCH submission hits a
+        shed threshold. The deadline budget starts NOW — time spent
+        queued counts against it."""
+        self._maybe_shed(request)
+        with self._handles_lock:
+            self._run_seq += 1
+            run_id = f"run-{self._run_seq}"
+        return self._admit(request, run_id)
+
+    def _admit(
+        self, request: RunRequest, run_id: str, journal: bool = True
+    ) -> RunHandle:
+        """Build the handle/ticket for ``run_id`` and push it. Journal
+        ordering is write-ahead: the submitted record lands durably
+        BEFORE the ticket can be scheduled, so a crash between the two
+        loses an unacknowledged submission, never an acknowledged one."""
         handle = RunHandle(run_id, request.tenant, request.priority)
         budget = None
         if request.deadline_s is not None:
@@ -247,7 +313,24 @@ class VerificationService:
             dataset_key=request.dataset_key,
         )
         tm = get_telemetry()
-        self.queue.push(ticket)  # raises QuotaExceeded pre-registration
+        if self.journal is not None:
+            if journal:
+                self.journal.record_submitted(
+                    run_id,
+                    tenant=request.tenant,
+                    priority=int(request.priority),
+                    deadline_s=request.deadline_s,
+                    dataset_key=request.dataset_key,
+                )
+            handle.on_terminal = self._journal_terminal
+        try:
+            self.queue.push(ticket)  # raises QuotaExceeded pre-registration
+        except QuotaExceeded:
+            if self.journal is not None:
+                self.journal.record_terminal(
+                    run_id, RunState.REJECTED, reason="tenant quota"
+                )
+            raise
         with self._handles_lock:
             self._handles[run_id] = handle
         tm.counter("service.submitted").inc()
@@ -261,6 +344,148 @@ class VerificationService:
             deadline_s=request.deadline_s,
         )
         return handle
+
+    # -- load shedding ---------------------------------------------------
+
+    def _maybe_shed(self, request: RunRequest) -> None:
+        """Reject a BATCH submission fast when the service is drowning
+        (deep queue or crashing children) — INTERACTIVE/STANDARD work is
+        never shed, matching the scheduler's reserve semantics."""
+        if request.priority < Priority.BATCH:
+            return
+        reason = None
+        retry_after = 0.0
+        if self.shed_queue_depth > 0:
+            depth = self.queue.depth()
+            if depth >= self.shed_queue_depth:
+                reason = (
+                    f"queue depth {depth} >= shed threshold "
+                    f"{self.shed_queue_depth}"
+                )
+                # rough drain estimate: today's depth at one run per
+                # worker-second — a HINT, not a promise
+                retry_after = depth / max(1, self.scheduler.workers)
+        if reason is None and self.shed_crash_rate > 0:
+            now = self.clock.now()
+            with self._crash_lock:
+                while self._crash_times and (
+                    now - self._crash_times[0] > self.shed_crash_window_s
+                ):
+                    self._crash_times.popleft()
+                crashes = len(self._crash_times)
+                oldest = self._crash_times[0] if self._crash_times else now
+            if crashes >= self.shed_crash_rate:
+                reason = (
+                    f"{crashes} child crashes in the last "
+                    f"{self.shed_crash_window_s:.0f}s"
+                )
+                retry_after = max(
+                    0.0, self.shed_crash_window_s - (now - oldest)
+                )
+        if reason is None:
+            return
+        tm = get_telemetry()
+        tm.counter("service.submissions_shed").inc()
+        tm.event(
+            "service_submission_shed",
+            tenant=request.tenant,
+            priority=Priority.name(request.priority),
+            reason=reason,
+            retry_after_s=retry_after,
+        )
+        raise ServiceOverloaded(
+            f"service overloaded ({reason}); retry in {retry_after:.1f}s",
+            retry_after_s=retry_after,
+        )
+
+    def _note_crash(self) -> None:
+        with self._crash_lock:
+            self._crash_times.append(self.clock.now())
+
+    # -- journal hooks ---------------------------------------------------
+
+    def _journal_terminal(self, handle: RunHandle) -> None:
+        if self.journal is None:
+            return
+        state, error = handle.terminal_info()
+        if state is None:
+            return
+        self.journal.record_terminal(
+            handle.run_id,
+            state,
+            error=(
+                f"{type(error).__name__}: {error}"[:500]
+                if error is not None
+                else None
+            ),
+        )
+
+    # -- restart recovery ------------------------------------------------
+
+    def recover(
+        self,
+        resolve: Optional[
+            Callable[[str, Dict[str, Any]], Optional[RunRequest]]
+        ] = None,
+    ) -> List[RunHandle]:
+        """Re-admit every journaled run that never reached a terminal
+        state — call ONCE on a fresh service over the journal dir of a
+        dead one, before accepting new traffic.
+
+        Journal records are JSON (checks/datasets hold closures that do
+        not serialize), so ``resolve(run_id, entry)`` rebuilds each
+        ``RunRequest`` from the journaled fields (tenant, priority,
+        deadline_s, dataset_key, started, last_checkpoint). Returning
+        None declares the run unresolvable: it is journaled FAILED
+        instead of silently dropped. Priority and deadline come from the
+        JOURNAL (the submit-pinned envelope), not the resolver. Runs
+        that already started resume mid-scan from their durable
+        checkpoint cursors the moment they re-execute."""
+        if self.journal is None:
+            return []
+        tm = get_telemetry()
+        pending = self.journal.pending_runs()
+        # continue run numbering past every journaled id — a recovered
+        # service must never mint a colliding run_id
+        top = 0
+        for run_id in pending:
+            tail = run_id.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                top = max(top, int(tail))
+        with self._handles_lock:
+            self._run_seq = max(self._run_seq, top)
+        recovered: List[RunHandle] = []
+        for run_id, entry in pending.items():
+            request = resolve(run_id, entry) if resolve is not None else None
+            if request is None:
+                self.journal.record_terminal(
+                    run_id,
+                    RunState.FAILED,
+                    error="unresolvable at recovery (no RunRequest)",
+                )
+                tm.event(
+                    "service_run_unrecoverable",
+                    run_id=run_id,
+                    tenant=entry.get("tenant"),
+                )
+                continue
+            if entry.get("priority") is not None:
+                request.priority = int(entry["priority"])
+            if entry.get("deadline_s") is not None:
+                request.deadline_s = float(entry["deadline_s"])
+            handle = self._admit(request, run_id, journal=False)
+            recovered.append(handle)
+            tm.event(
+                "service_run_recovered",
+                run_id=run_id,
+                tenant=entry.get("tenant"),
+                started=bool(entry.get("started")),
+                last_checkpoint=entry.get("last_checkpoint"),
+            )
+        if recovered:
+            tm.counter("service.runs_recovered").inc(len(recovered))
+        self.journal.compact()
+        return recovered
 
     def handle(self, run_id: str) -> Optional[RunHandle]:
         with self._handles_lock:
@@ -290,6 +515,27 @@ class VerificationService:
     # -- the real executor ----------------------------------------------
 
     def _execute(self, ticket: RunTicket):
+        request: RunRequest = ticket.payload
+        if self.journal is not None:
+            self.journal.record_started(
+                ticket.handle.run_id, tenant=request.tenant
+            )
+        if self.isolated:
+            payload = self._isolation_payload(ticket)
+            if payload is not None:
+                return self._execute_isolated(ticket, payload)
+            get_telemetry().counter(
+                "service.isolation_inline_fallbacks"
+            ).inc()
+            get_telemetry().event(
+                "service_isolation_fallback",
+                run_id=ticket.handle.run_id,
+                reason="request does not pickle (closures in "
+                "checks/dataset_factory); executing in-process",
+            )
+        return self._execute_inline(ticket)
+
+    def _execute_inline(self, ticket: RunTicket):
         from deequ_tpu.verification.suite import VerificationSuite
 
         request: RunRequest = ticket.payload
@@ -302,11 +548,23 @@ class VerificationService:
             dataset_key=request.dataset_key,
             cache_hit=hit,
         )
+        engine = None
+        if self._checkpoint_path is not None:
+            from deequ_tpu.engine.scan import AnalysisEngine
+
+            engine = AnalysisEngine(
+                checkpointer=_JournalingCheckpointer(
+                    self._checkpoint_path,
+                    self.journal,
+                    ticket.handle.run_id,
+                )
+            )
         try:
             result = VerificationSuite.do_verification_run(
                 dataset,
                 request.checks,
                 required_analyzers=request.required_analyzers,
+                engine=engine,
                 metrics_repository=request.metrics_repository,
                 save_or_append_results_with_key=request.result_key,
                 deadline=ticket.budget,
@@ -320,6 +578,69 @@ class VerificationService:
         self.plans.record_run(getattr(result, "telemetry", None))
         return result
 
+    # -- isolated (child-process) execution ------------------------------
+
+    def _isolation_payload(
+        self, ticket: RunTicket
+    ) -> Optional[Dict[str, Any]]:
+        """The spawn-safe payload for this run, or None when the request
+        holds closures that cannot cross a process boundary (the caller
+        then falls back to in-process execution, loudly)."""
+        request: RunRequest = ticket.payload
+        payload = {
+            "run_id": ticket.handle.run_id,
+            "dataset_key": request.dataset_key,
+            "dataset_factory": request.dataset_factory,
+            "checks": list(request.checks),
+            "required_analyzers": list(request.required_analyzers),
+            "checkpoint_path": self._checkpoint_path,
+            "deadline_s": (
+                ticket.budget.remaining()
+                if ticket.budget is not None
+                else None
+            ),
+        }
+        try:
+            pickle.dumps(payload)
+        except Exception:  # noqa: BLE001 — any closure anywhere inside
+            return None
+        return payload
+
+    def _execute_isolated(self, ticket: RunTicket, payload: Dict[str, Any]):
+        from deequ_tpu.engine.subproc import checkpoint_progress_probe
+
+        request: RunRequest = ticket.payload
+        probe = (
+            checkpoint_progress_probe(self._checkpoint_path)
+            if self._checkpoint_path is not None
+            else None
+        )
+        runner = IsolatedRunner(
+            key=f"dataset:{request.dataset_key}",
+            progress_probe=probe,
+            timeout_s=(
+                ticket.budget.remaining()
+                if ticket.budget is not None
+                else None
+            ),
+            clock=self.clock,
+        )
+        try:
+            result = runner.run(_isolated_execute, payload)
+        except CrashLoopError as exc:
+            self._note_crash()
+            from deequ_tpu import config
+
+            policy = config.options().degradation_policy
+            if policy == "fail":
+                raise
+            # warn/tolerate flooring: a crash loop yields NO partial
+            # data, so the floored result is an empty one that carries
+            # the crash provenance instead of failing the handle
+            return _crash_loop_result(exc, policy)
+        self.plans.record_run(getattr(result, "telemetry", None))
+        return result
+
     # -- introspection --------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
@@ -328,6 +649,89 @@ class VerificationService:
             "datasets": self.datasets.snapshot(),
             "plans": self.plans.snapshot(),
         }
+
+
+class _JournalingCheckpointer(ScanCheckpointer):
+    """A ``ScanCheckpointer`` that also appends a journal ``checkpoint``
+    record per save, so replay knows how far a dead run had progressed
+    (the cursor itself lives in the checkpoint blob — the journal only
+    records THAT progress happened, and where)."""
+
+    def __init__(
+        self,
+        path: str,
+        journal: Optional[RunJournal],
+        run_id: str,
+        every_batches: Optional[int] = None,
+    ):
+        super().__init__(path, every_batches)
+        self._journal = journal
+        self._run_id = run_id
+
+    def save(self, cursor, plan_token, states, host_accs, degradation):
+        super().save(cursor, plan_token, states, host_accs, degradation)
+        if self._journal is not None:
+            self._journal.record_checkpoint(
+                self._run_id,
+                batch_index=int(cursor.batch_index),
+                row_offset=int(cursor.row_offset),
+                plan_token=plan_token,
+            )
+
+
+def _isolated_execute(payload: Dict[str, Any]):
+    """Child-process entry for one isolated verification run (module
+    level: spawn pickles it by reference). Rebuilds the dataset from
+    its factory, attaches a checkpointer over the service's durable
+    checkpoint path — so a relaunched child resumes mid-scan — and
+    strips ``_data`` from the result (device buffers do not cross the
+    pipe; row-level export needs an in-process run)."""
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    engine = None
+    if payload.get("checkpoint_path"):
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        engine = AnalysisEngine(
+            checkpointer=ScanCheckpointer(payload["checkpoint_path"])
+        )
+    dataset = payload["dataset_factory"]()
+    result = VerificationSuite.do_verification_run(
+        dataset,
+        payload["checks"],
+        required_analyzers=payload["required_analyzers"],
+        engine=engine,
+        deadline=payload.get("deadline_s"),
+    )
+    result._data = None
+    return result
+
+
+def _crash_loop_result(exc: CrashLoopError, policy: str):
+    """The floored result of a crash-looped run under a non-"fail"
+    degradation policy: empty metrics, status WARNING ("warn") or
+    SUCCESS ("tolerate"), with the crash provenance riding the
+    degradation record."""
+    from deequ_tpu.checks import CheckStatus
+    from deequ_tpu.engine.resilience import BatchFailure, ScanDegradation
+    from deequ_tpu.verification.suite import VerificationResult
+
+    status = (
+        CheckStatus.WARNING if policy == "warn" else CheckStatus.SUCCESS
+    )
+    result = VerificationResult(status, {}, {})
+    degradation = ScanDegradation()
+    degradation.failures.append(
+        BatchFailure(
+            batch_index=-1,
+            rows=0,
+            error_class=type(exc).__name__,
+            message=str(exc)[:500],
+            attempts=int(exc.launches),
+        )
+    )
+    result.degradation = degradation
+    return result
 
 
 def _load_warm_plans():

@@ -29,7 +29,20 @@ so a failing test replays byte-for-byte:
   stall/deadline detection on a batch that DOES show up);
 - ``on_batch={index: callable}`` — the callable runs every time the
   batch is produced, before fault checks (the deterministic trigger for
-  cancel-mid-scan tests: cancel a token at exactly batch k).
+  cancel-mid-scan tests: cancel a token at exactly batch k);
+- ``crash_at_batch=N`` — producing batch N HARD-CRASHES the process
+  (``signal.raise_signal(SIGSEGV)``, with ``os._exit(139)`` as the
+  fallback): no exception, no unwinding, no atexit — the deterministic
+  stand-in for a real XLA segfault, driving ``engine/subproc.py``'s
+  relaunch path without flaky real crashes. ``crash_token_path`` gives
+  cross-process crash-once semantics: the in-memory ledger dies WITH
+  the process, so the wrapper drops a marker file before crashing and a
+  relaunched child that finds the marker serves the batch normally.
+  Without a token path the batch is POISON — it crashes every launch,
+  which is exactly what the crash-loop breaker tests need;
+- ``crash_every_n=k`` — every k-th batch hard-crashes (token-gated per
+  index when ``crash_token_path`` is set, so each crash fires once
+  across the run's relaunch chain).
 
 Memory-pressure faults (engine/memory.py) fire through the engine's
 ``oom_probe`` protocol — the engine calls ``probe(stage, index, rows)``
@@ -60,6 +73,8 @@ flaky source that eventually serves the batch.
 
 from __future__ import annotations
 
+import os
+import signal
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Set
 
 import numpy as np
@@ -70,6 +85,20 @@ from deequ_tpu.engine.resilience import (
     ScanStalled,
     TransientScanError,
 )
+
+
+def hard_crash(signum: Optional[int] = None) -> None:
+    """Kill THIS process the way a real fault would: raise the signal
+    (default SIGSEGV — the parent sees exitcode ``-signum``), falling
+    back to ``os._exit(128 + signum)`` (the shell convention, e.g. 139)
+    when the signal somehow returns. Never raises, never unwinds, never
+    runs atexit — by design."""
+    num = int(signum) if signum is not None else int(signal.SIGSEGV)
+    try:
+        signal.raise_signal(num)
+    except Exception:  # noqa: BLE001 — no signal support: exit hard
+        pass
+    os._exit(128 + num)
 
 
 class FaultInjectingDataset:
@@ -91,6 +120,10 @@ class FaultInjectingDataset:
         corrupt: Optional[Iterable[int]] = None,
         kill_at_batch: Optional[int] = None,
         kill_once: bool = True,
+        crash_at_batch: Optional[int] = None,
+        crash_every_n: int = 0,
+        crash_token_path: Optional[str] = None,
+        crash_signum: Optional[int] = None,
         hang_at_batch: Optional[Any] = None,
         slow_batch: Optional[Dict[int, float]] = None,
         on_batch: Optional[Dict[int, Callable[[], None]]] = None,
@@ -112,6 +145,11 @@ class FaultInjectingDataset:
         self._kill_at_batch = kill_at_batch
         self._kill_once = kill_once
         self._killed = False
+        # hard-crash faults (process death, not exceptions)
+        self._crash_at_batch = crash_at_batch
+        self._crash_every_n = int(crash_every_n)
+        self._crash_token_path = crash_token_path
+        self._crash_signum = crash_signum
         # hang_at_batch accepts {index: n_hangs} or a bare iterable of
         # indices (one hang each)
         if hang_at_batch is None:
@@ -252,10 +290,35 @@ class FaultInjectingDataset:
                 )
         raise ScanStalled(f"injected hang at batch {index} interrupted")
 
+    def _crash_due(self, index: int) -> bool:
+        if self._crash_at_batch is not None and index == self._crash_at_batch:
+            return True
+        return (
+            self._crash_every_n > 0
+            and index > 0
+            and index % self._crash_every_n == 0
+        )
+
+    def _maybe_crash(self, index: int) -> None:
+        """Hard process death at ``index`` — fires BEFORE the softer
+        faults (a segfault does not politely defer to a retry)."""
+        if not self._crash_due(index):
+            return
+        if self._crash_token_path is not None:
+            token = f"{self._crash_token_path}.crashed-b{index}"
+            if os.path.exists(token):
+                return  # this launch already paid the crash here
+            with open(token, "x", encoding="utf-8") as fh:
+                fh.write(f"batch {index}\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        hard_crash(self._crash_signum)
+
     def _check_faults(self, index: int) -> None:
         """Raise the configured fault for ``index``, if any — BEFORE the
         item is yielded, so the engine's failing-index arithmetic
         (start + items_yielded) lands exactly on ``index``."""
+        self._maybe_crash(index)
         if (
             self._kill_at_batch is not None
             and index == self._kill_at_batch

@@ -1032,3 +1032,140 @@ class TestThreadDiscipline:
         found = _rules_found(tmp_path, "thread-discipline")
         assert len(found) == 1
         assert "outside the sanctioned" in found[0].message
+
+
+# --------------------------------------------------------------------------
+# subprocess-discipline
+# --------------------------------------------------------------------------
+
+
+PROC_STRAY_IMPORT = """
+    import multiprocessing
+
+    def launch(fn):
+        proc = multiprocessing.Process(target=fn)
+        proc.start()
+        return proc
+"""
+
+PROC_CORRECTED = """
+    import multiprocessing
+
+    def launch(fn):
+        ctx = multiprocessing.get_context("spawn")
+        proc = ctx.Process(target=fn)
+        proc.start()
+        try:
+            pass
+        finally:
+            proc.join()
+        return proc.exitcode
+"""
+
+PROC_FORK_CONTEXT = """
+    import multiprocessing
+
+    def launch(fn):
+        ctx = multiprocessing.get_context("fork")
+        proc = ctx.Process(target=fn)
+        proc.start()
+        proc.join()
+        return proc.exitcode
+"""
+
+PROC_BARE_PROCESS = """
+    import multiprocessing
+
+    def launch(fn):
+        proc = multiprocessing.Process(target=fn)
+        proc.start()
+        proc.join()
+        return proc.exitcode
+"""
+
+PROC_STARTED_NOT_JOINED = """
+    import multiprocessing
+
+    def launch(fn):
+        ctx = multiprocessing.get_context("spawn")
+        proc = ctx.Process(target=fn)
+        proc.start()
+        return proc
+"""
+
+PROC_OS_FORK = """
+    import os
+
+    def launch():
+        pid = os.fork()
+        return pid
+"""
+
+PROC_WAIVED = """
+    import subprocess  # lint-ok: subprocess-discipline: fixture lifecycle documented here
+
+    def run(cmd):
+        return subprocess.run(cmd, check=True)
+"""
+
+
+class TestSubprocessDiscipline:
+    SANCTIONED_REL = "deequ_tpu/engine/subproc.py"
+    STRAY_REL = "deequ_tpu/analyzers/fixture.py"
+
+    def test_catches_stray_multiprocessing_import(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, PROC_STRAY_IMPORT)
+        found = _rules_found(tmp_path, "subprocess-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "multiprocessing"
+        assert "sanctioned" in found[0].message
+
+    def test_silent_on_corrected_twin_in_sanctioned_module(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, PROC_CORRECTED)
+        assert _rules_found(tmp_path, "subprocess-discipline") == []
+
+    def test_catches_fork_context_in_sanctioned_module(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, PROC_FORK_CONTEXT)
+        found = _rules_found(tmp_path, "subprocess-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "get_context"
+        assert "'fork'" in found[0].message
+
+    def test_catches_bare_process_construction(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, PROC_BARE_PROCESS)
+        found = _rules_found(tmp_path, "subprocess-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "Process"
+        assert "get_context('spawn')" in found[0].message
+
+    def test_catches_started_never_joined(self, tmp_path):
+        _write(tmp_path, self.SANCTIONED_REL, PROC_STARTED_NOT_JOINED)
+        found = _rules_found(tmp_path, "subprocess-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "proc"
+        assert "zombie" in found[0].message
+
+    def test_catches_os_fork_anywhere(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, PROC_OS_FORK)
+        found = _rules_found(tmp_path, "subprocess-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "fork"
+
+    def test_waiver_with_reason_is_honored(self, tmp_path):
+        _write(tmp_path, self.STRAY_REL, PROC_WAIVED)
+        assert _rules_found(tmp_path, "subprocess-discipline") == []
+        waived = [
+            f
+            for f in run_analyzers(str(tmp_path))
+            if f.rule == "subprocess-discipline" and f.waived
+        ]
+        assert len(waived) == 1
+        assert waived[0].waive_reason
+
+    def test_shipped_subproc_module_is_clean(self):
+        found = [
+            f
+            for f in unwaived(run_analyzers(REPO_ROOT))
+            if f.rule == "subprocess-discipline"
+        ]
+        assert found == []

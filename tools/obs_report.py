@@ -388,6 +388,114 @@ def render_service(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_crash_recovery(records: List[Dict[str, Any]]) -> str:
+    """The ``crash recovery:`` section (docs/RESILIENCE.md): child
+    crashes by signal, relaunches and checkpoint resumes, crash loops
+    and breaker trips, journaled runs re-admitted after a daemon
+    restart, and load-shed submissions — the whole process-level fault
+    story from one JSONL artifact. Empty string when the artifact has
+    no crash/recovery signals."""
+    counters: Dict[str, float] = {}
+    for r in load_runs(records):
+        for k, v in r.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    events = [r for r in records if r.get("type") == "event"]
+    crashes = [e for e in events if e.get("event") == "child_crashed"]
+    recovered = [
+        e for e in events if e.get("event") == "service_run_recovered"
+    ]
+    shed = [
+        e for e in events if e.get("event") == "service_submission_shed"
+    ]
+    breaker_opens = [
+        e for e in events if e.get("event") == "crash_breaker_open"
+    ]
+    torn = [e for e in events if e.get("event") == "journal_truncated"]
+
+    child_crashes = int(counters.get("engine.child_crashes", 0)) or len(
+        crashes
+    )
+    runs_recovered = int(
+        counters.get("service.runs_recovered", 0)
+    ) or len(recovered)
+    shed_count = int(
+        counters.get("service.submissions_shed", 0)
+    ) or len(shed)
+    if not any(
+        (child_crashes, runs_recovered, shed_count, breaker_opens, torn)
+    ):
+        return ""
+
+    lines = ["crash recovery:"]
+    if child_crashes:
+        by_signal: Dict[str, int] = {}
+        for e in crashes:
+            sig = str(e.get("signal") or "exit")
+            by_signal[sig] = by_signal.get(sig, 0) + 1
+        sig_detail = (
+            " ("
+            + ", ".join(
+                f"{k}={v}" for k, v in sorted(by_signal.items())
+            )
+            + ")"
+            if by_signal
+            else ""
+        )
+        lines.append(f"  child crashes: {child_crashes}{sig_detail}")
+        relaunches = int(counters.get("engine.child_relaunches", 0))
+        resumes = int(counters.get("engine.crash_resumes", 0))
+        if relaunches or resumes:
+            lines.append(
+                f"  relaunches: {relaunches},"
+                f" completed after resume: {resumes}"
+            )
+        loops = int(counters.get("engine.crash_loops", 0))
+        if loops:
+            lines.append(f"  crash loops declared: {loops}")
+    trips = int(counters.get("engine.breaker_trips", 0)) or len(
+        breaker_opens
+    )
+    if trips:
+        keys = sorted(
+            {str(e.get("key", "?")) for e in breaker_opens}
+        )
+        lines.append(
+            f"  breaker trips: {trips}"
+            + (f" (keys: {', '.join(keys)})" if keys else "")
+        )
+    if runs_recovered:
+        resumed = sum(
+            1 for e in recovered if e.get("last_checkpoint")
+        )
+        lines.append(
+            f"  runs recovered after restart: {runs_recovered}"
+            f" ({resumed} from a checkpoint cursor)"
+        )
+    if shed_count:
+        reasons: Dict[str, int] = {}
+        for e in shed:
+            reason = str(e.get("reason", "?"))
+            reasons[reason] = reasons.get(reason, 0) + 1
+        reason_detail = (
+            " ("
+            + ", ".join(
+                f"{k}={v}" for k, v in sorted(reasons.items())
+            )
+            + ")"
+            if reasons
+            else ""
+        )
+        lines.append(
+            f"  submissions shed: {shed_count}{reason_detail}"
+        )
+    for e in torn:
+        lines.append(
+            f"  journal truncated at seq {e.get('at_seq', '?')}:"
+            f" torn tail dropped on replay"
+        )
+    return "\n".join(lines)
+
+
 def render_staticcheck(root: Optional[str] = None) -> str:
     """One-line static-analysis health summary, e.g. ``staticcheck: 0
     finding(s), 29 waived across 12 rules (clean)``."""
@@ -416,10 +524,14 @@ def render(
     run_id: Optional[int] = None,
     counters_only: bool = False,
     service_only: bool = False,
+    crashes_only: bool = False,
 ) -> str:
     if service_only:
         section = render_service(records)
         return section or "no service events in artifact"
+    if crashes_only:
+        section = render_crash_recovery(records)
+        return section or "no crash/recovery signals in artifact"
     runs = load_runs(records)
     if run_id is not None:
         runs = [r for r in runs if r.get("run_id") == run_id]
@@ -449,6 +561,9 @@ def render(
         section = render_service(records)
         if section:
             body = body + "\n\n" + section
+        crash_section = render_crash_recovery(records)
+        if crash_section:
+            body = body + "\n\n" + crash_section
     return body
 
 
@@ -469,6 +584,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--service", action="store_true",
         help="print only the multi-tenant service section",
+    )
+    parser.add_argument(
+        "--crashes", action="store_true",
+        help="print only the crash isolation / recovery section",
     )
     parser.add_argument(
         "--staticcheck", action="store_true",
@@ -492,6 +611,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_id=args.run,
         counters_only=args.counters,
         service_only=args.service,
+        crashes_only=args.crashes,
     ))
     if args.staticcheck:
         print(render_staticcheck())

@@ -1,0 +1,254 @@
+"""Subprocess-discipline analyzer: every child process in the product
+tree must come from the one module that owns the process lifecycle.
+
+PR 11's crash isolation multiplied the number of PROCESSES the engine
+may run at once, and its contracts — children always reaped (the
+no-zombie assertion in tier-1), children always spawn-started (a forked
+JAX child inherits locked allocator/backend state and deadlocks or
+corrupts; see docs/RESILIENCE.md) — only hold if process creation is
+centralized. One rule, three checks:
+
+``subprocess-discipline``
+
+1. **Sanctioned modules** — ``multiprocessing`` / ``subprocess`` /
+   ``concurrent.futures.ProcessPoolExecutor`` may only be imported in
+   the modules that own a documented child lifecycle (today:
+   ``engine/subproc.py``). A child spawned from an analyzer or a codec
+   has no owner to reap it and no crash classification.
+2. **Spawn, never fork** — ``os.fork``/``forkpty``/``posix_spawn`` are
+   flagged everywhere, and inside sanctioned modules
+   ``multiprocessing.get_context`` must be called with ``"spawn"``;
+   constructing ``multiprocessing.Process`` directly (platform default
+   = fork on Linux) is flagged too.
+3. **Reaped, never zombied** — a process object that is ``.start()``ed
+   in a sanctioned module must also be ``.join()``ed somewhere in that
+   module (the ``finally``-block reap in ``IsolatedRunner``); a started
+   child nobody joins becomes a zombie holding its exit status.
+
+Waive with ``# lint-ok: subprocess-discipline: <reason>`` where a site
+carries its own documented lifecycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+#: modules with a documented child-process lifecycle (spawn + reap)
+SANCTIONED = frozenset(
+    {
+        "deequ_tpu/engine/subproc.py",
+    }
+)
+
+#: top-level modules whose import means "this file makes processes"
+PROCESS_MODULES = frozenset({"multiprocessing", "subprocess"})
+
+#: fork-family calls: never legal in the product tree — a forked JAX
+#: child shares the parent's backend/allocator state mid-mutation
+FORK_CALLS = frozenset(
+    {
+        "os.fork",
+        "os.forkpty",
+        "os.posix_spawn",
+        "os.posix_spawnp",
+        "pty.fork",
+    }
+)
+
+
+def _call_tail(callee: str) -> str:
+    return callee.split(".")[-1]
+
+
+def _from_imports(tree: ast.AST, module: str) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            names.update(alias.asname or alias.name for alias in node.names)
+    return names
+
+
+class SubprocessDisciplineAnalyzer(Analyzer):
+    name = "procs"
+    rules = ("subprocess-discipline",)
+    description = (
+        "child processes only in sanctioned modules, spawn-started "
+        "(never forked), and always joined/reaped"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if sf.tree is None or not sf.rel.startswith("deequ_tpu/"):
+                continue
+            yield from self._analyze_file(sf)
+
+    # -- per-file ---------------------------------------------------------
+
+    def _analyze_file(self, sf: SourceFile) -> Iterable[Finding]:
+        sanctioned = sf.rel in SANCTIONED
+        mp_names = _from_imports(sf.tree, "multiprocessing")
+
+        yield from self._check_imports(sf, sanctioned)
+        yield from self._check_calls(sf, sanctioned, mp_names)
+        if sanctioned:
+            yield from self._check_reaping(sf)
+
+    def _check_imports(
+        self, sf: SourceFile, sanctioned: bool
+    ) -> Iterable[Finding]:
+        if sanctioned:
+            return
+        for node in ast.walk(sf.tree):
+            modules: List[str] = []
+            if isinstance(node, ast.Import):
+                modules = [alias.name.split(".")[0] for alias in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                top = node.module.split(".")[0]
+                modules = [top]
+                if node.module.startswith("concurrent"):
+                    # from concurrent.futures import ProcessPoolExecutor
+                    if any(
+                        alias.name == "ProcessPoolExecutor"
+                        for alias in node.names
+                    ):
+                        modules = ["multiprocessing"]
+                    else:
+                        modules = []
+            else:
+                continue
+            for top in modules:
+                if top in PROCESS_MODULES:
+                    yield Finding(
+                        rule="subprocess-discipline",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{top} imported outside the sanctioned "
+                            "process modules — a child spawned here has "
+                            "no owner to reap it and no crash "
+                            "classification; route the work through "
+                            "engine/subproc.py (IsolatedRunner), or "
+                            "waive with the lifecycle that reaps it"
+                        ),
+                        symbol=top,
+                    )
+
+    def _check_calls(
+        self, sf: SourceFile, sanctioned: bool, mp_names: Set[str]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func) or ""
+            if callee in FORK_CALLS:
+                yield Finding(
+                    rule="subprocess-discipline",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        f"{callee} forks the interpreter — a forked "
+                        "JAX child inherits locked allocator/backend "
+                        "state; use a spawn context via "
+                        "engine/subproc.py instead"
+                    ),
+                    symbol=_call_tail(callee),
+                )
+                continue
+            if not sanctioned:
+                continue
+            tail = _call_tail(callee)
+            is_mp_attr = callee.startswith("multiprocessing.")
+            is_mp_name = len(callee.split(".")) == 1 and tail in mp_names
+            if tail == "get_context" and (is_mp_attr or is_mp_name):
+                method = None
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    method = node.args[0].value
+                elif node.args:
+                    method = "<dynamic>"
+                if method != "spawn":
+                    yield Finding(
+                        rule="subprocess-discipline",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "multiprocessing context must be "
+                            "get_context('spawn') — the platform "
+                            "default (fork on Linux) deadlocks "
+                            "children that inherit JAX state; got "
+                            f"{method!r}"
+                        ),
+                        symbol="get_context",
+                    )
+            elif tail in ("Process", "Pool") and (is_mp_attr or is_mp_name):
+                yield Finding(
+                    rule="subprocess-discipline",
+                    path=sf.rel,
+                    line=node.lineno,
+                    message=(
+                        f"bare multiprocessing.{tail} uses the "
+                        "platform-default start method (fork on "
+                        "Linux); construct via "
+                        "get_context('spawn').{0}".format(tail)
+                    ),
+                    symbol=tail,
+                )
+
+    def _check_reaping(self, sf: SourceFile) -> Iterable[Finding]:
+        """Every name assigned from a ``*.Process(...)`` construction
+        that is ``.start()``ed must also be ``.join()``ed in this
+        module — the reap that prevents zombies."""
+        process_names: Set[str] = set()
+        started: Dict[str, int] = {}
+        joined: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = dotted_name(node.targets[0])
+                value = node.value
+                if (
+                    target is not None
+                    and isinstance(value, ast.Call)
+                    and _call_tail(dotted_name(value.func) or "")
+                    == "Process"
+                ):
+                    process_names.add(target)
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                receiver = dotted_name(node.func.value)
+                if receiver is None:
+                    continue
+                if node.func.attr == "start":
+                    started.setdefault(receiver, node.lineno)
+                elif node.func.attr in ("join", "kill", "terminate"):
+                    if node.func.attr == "join":
+                        joined.add(receiver)
+        for name, line in sorted(started.items(), key=lambda kv: kv[1]):
+            if name not in process_names:
+                continue  # not a Process (a thread, a timer, ...)
+            if name not in joined:
+                yield Finding(
+                    rule="subprocess-discipline",
+                    path=sf.rel,
+                    line=line,
+                    message=(
+                        f"process {name!r} is started but never "
+                        "joined in this module — an unreaped child "
+                        "becomes a zombie holding its exit status; "
+                        "join it in a finally block"
+                    ),
+                    symbol=name,
+                )
+
+
+register(SubprocessDisciplineAnalyzer())
