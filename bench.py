@@ -141,6 +141,9 @@ CONFIG_REGISTRY = {
     "service_concurrent_suites": (
         lambda a: bench_service_concurrent_suites(a["rows"], a["clients"])
     ),
+    "service_coalesced_suites": (
+        lambda a: bench_service_coalesced_suites(a["rows"], a["clients"])
+    ),
     "spill_grouping_12M_distinct": lambda a: bench_spill_grouping(a["rows"]),
     "joint_grouping_mi_1Mcard_pair": lambda a: bench_joint_grouping(a["rows"]),
     "streaming_parquet": (
@@ -1337,6 +1340,158 @@ def bench_service_concurrent_suites(
         svc.stop(drain=False, timeout=30)
 
 
+def bench_service_coalesced_suites(
+    num_rows: int = 2_000_000, clients: int = 4
+):
+    """Scan coalescing (docs/SERVICE.md "Scan coalescing"): K
+    overlapping BATCH suites against ONE shared dataset key, run twice
+    through otherwise-identical services — coalescing OFF then ON.
+    The ON phase must show ``engine.data_passes`` collapse from ~K to
+    ~1 while per-run results stay identical; two INTERACTIVE gate runs
+    ride along in each phase so the queue-wait split by priority class
+    shows coalescing never taxes the interactive path (the ISSUE's
+    acceptance criterion). Suites are submitted BEFORE the workers
+    start (window 0): the first pop atomically absorbs every queued
+    compatible ticket, so grouping is deterministic, not racy."""
+    import threading
+
+    import pyarrow as pa
+
+    from deequ_tpu import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.service import (
+        Priority,
+        RunRequest,
+        VerificationService,
+    )
+    from deequ_tpu.telemetry import get_telemetry
+
+    def make():
+        rng = np.random.default_rng(5)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "k1": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "k2": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "v1": rng.normal(0, 1, num_rows).astype(np.float32),
+                    "v2": rng.normal(0, 1, num_rows).astype(np.float32),
+                }
+            )
+        )
+
+    def suite(i):
+        # K overlapping tenant suites: everyone wants completeness on
+        # k1; the rest differs per tenant, so the superset is a real
+        # union, not K copies of one suite
+        check = Check(CheckLevel.ERROR, f"tenant-suite-{i}").is_complete(
+            "k1"
+        )
+        if i % 2 == 0:
+            check = check.is_complete("v1").is_non_negative("k2")
+        else:
+            check = check.is_complete("v2")
+        return [check]
+
+    def gate():
+        return [
+            Check(CheckLevel.ERROR, "gate").is_complete("v1")
+        ]
+
+    tm = get_telemetry()
+
+    def phase(coalesce_on: bool):
+        svc = VerificationService(
+            workers=2,
+            interactive_reserve=1,
+            coalesce=coalesce_on,
+            coalesce_window_s=0.0,
+        )
+        batch = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"tenant-{i}",
+                    checks=suite(i),
+                    dataset_key="bench/coalesce",
+                    dataset_factory=make,
+                    priority=Priority.BATCH,
+                )
+            )
+            for i in range(clients)
+        ]
+        inter = [
+            svc.submit(
+                RunRequest(
+                    tenant="risk",
+                    checks=gate(),
+                    dataset_key="bench/coalesce",
+                    dataset_factory=make,
+                    priority=Priority.INTERACTIVE,
+                )
+            )
+            for _ in range(2)
+        ]
+        passes_before = tm.counter("engine.data_passes").value
+        t0 = time.time()
+        svc.start()
+        try:
+            threads = [
+                threading.Thread(target=h.wait, args=(600,))
+                for h in batch + inter
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.time() - t0
+        finally:
+            svc.stop(drain=False, timeout=30)
+        passes = tm.counter("engine.data_passes").value - passes_before
+
+        def waits(handles):
+            return sorted(
+                max(0.0, h.started_at - h.submitted_at) for h in handles
+            )
+        batch_waits = waits(batch)
+        inter_waits = waits(inter)
+        total = len(batch) + len(inter)
+        return {
+            "wall_s": round(wall, 3),
+            "runs_per_sec": round(total / wall, 3) if wall else 0.0,
+            "data_passes": int(passes),
+            "batch_wait_p50_s": round(
+                batch_waits[len(batch_waits) // 2], 4
+            ),
+            "batch_wait_p99_s": round(batch_waits[-1], 4),
+            "interactive_wait_p50_s": round(
+                inter_waits[len(inter_waits) // 2], 4
+            ),
+            "interactive_wait_p99_s": round(inter_waits[-1], 4),
+        }
+
+    saved_before = tm.counter("service.scan_passes_saved").value
+    off = phase(False)
+    on = phase(True)
+    saved = tm.counter("service.scan_passes_saved").value - saved_before
+    return {
+        "rows": num_rows,
+        "clients": clients,
+        "off": off,
+        "on": on,
+        "scan_passes_saved": int(saved),
+        "data_passes_off": off["data_passes"],
+        "data_passes_on": on["data_passes"],
+        "speedup": (
+            round(off["wall_s"] / on["wall_s"], 3)
+            if on["wall_s"]
+            else 0.0
+        ),
+    }
+
+
 def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
     """BASELINE.json config 2 at its SPECIFIED scale, streamed:
     Mean/StdDev/Min/Max/Compliance over 10 numeric f32 columns,
@@ -1670,6 +1825,12 @@ def main(argv=None):
                 {"rows": 2_000_000, "clients": 8},
                 False,
                 90,
+            ),
+            (
+                "service_coalesced_suites",
+                {"rows": 2_000_000, "clients": 4},
+                False,
+                120,
             ),
             ("spill_grouping_12M_distinct", {"rows": 12_000_000}, False, 120),
             (

@@ -294,6 +294,15 @@ class Analyzer:
     def instance(self) -> str:
         raise NotImplementedError
 
+    @property
+    def identity_key(self) -> str:
+        """Stable identity for context slicing (``AnalyzerContext.subset``
+        and the service-side scan coalescer). Frozen dataclass ``repr`` is
+        deterministic and parameter-complete — two analyzers with equal
+        keys compute the same metric on the same data, the same contract
+        ``make_cache_token`` already leans on."""
+        return repr(self)
+
     # -- contract -------------------------------------------------------
 
     def preconditions(self) -> List[Precondition]:

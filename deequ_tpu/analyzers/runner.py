@@ -65,6 +65,30 @@ class AnalyzerContext:
     def metric(self, analyzer: Analyzer) -> Optional[Metric]:
         return self.metric_map.get(analyzer)
 
+    def subset(self, analyzers: Sequence[Analyzer]) -> "AnalyzerContext":
+        """Slice this context down to ``analyzers``, matched by
+        :attr:`Analyzer.identity_key` — the projection a coalesced
+        superset run uses to hand each tenant exactly what a solo run
+        of its suite would have produced. Metrics are matched by
+        identity (not object equality) so a tenant's own analyzer
+        instances key the returned map; run provenance — metadata,
+        telemetry summary, degradation, interruption — is carried
+        whole, because it describes the one physical scan every member
+        shared."""
+        wanted = {a.identity_key: a for a in _dedup(analyzers)}
+        sliced = {}
+        for have, metric in self.metric_map.items():
+            target = wanted.get(have.identity_key)
+            if target is not None:
+                sliced[target] = metric
+        return AnalyzerContext(
+            sliced,
+            run_metadata=self.run_metadata,
+            telemetry=self.telemetry,
+            degradation=self.degradation,
+            interruption=self.interruption,
+        )
+
     def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
         from deequ_tpu.engine.deadline import ScanInterruption
         from deequ_tpu.engine.resilience import ScanDegradation
@@ -249,6 +273,32 @@ class AnalysisRunner:
             if admitted:
                 admission_controller().release(est_bytes)
             engine.budget, engine.cancel = prev_budget, prev_cancel
+
+    @staticmethod
+    def do_coalesced_analysis_run(
+        data: Dataset,
+        suites: Sequence[Sequence[Analyzer]],
+        engine: Optional[AnalysisEngine] = None,
+        deadline=None,
+        cancel=None,
+    ) -> List[AnalyzerContext]:
+        """One scan, many tenants: union every suite's analyzers, run
+        ONE ``do_analysis_run`` over the superset, then :meth:`slice
+        <AnalyzerContext.subset>` each suite's context back out.
+        Analyzer states are commutative monoids and the fused pass
+        already slices each vectorized member's state individually, so
+        a superset scan's per-analyzer metrics equal a solo run's by
+        construction (pinned differentially in tests/test_coalesce.py).
+        Returns one context per input suite, in order."""
+        union = _dedup([a for suite in suites for a in suite])
+        superset = AnalysisRunner.do_analysis_run(
+            data,
+            union,
+            engine=engine,
+            deadline=deadline,
+            cancel=cancel,
+        )
+        return [superset.subset(list(suite)) for suite in suites]
 
     @staticmethod
     def _do_admitted_run(

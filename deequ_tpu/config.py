@@ -282,6 +282,26 @@ class Options:
     service_shed_crash_window_s: float = float(
         os.environ.get("DEEQU_TPU_SERVICE_SHED_CRASH_WINDOW", 60.0)
     )
+    # scan coalescing (docs/SERVICE.md "Scan coalescing"): compatible
+    # queued runs targeting the same dataset_key share ONE superset
+    # scan, each tenant's AnalyzerContext sliced back out. Opt-in (like
+    # pallas_scatter/isolated_execution): default-off keeps existing
+    # solo-run latency/ordering semantics untouched
+    service_coalesce: bool = (
+        os.environ.get("DEEQU_TPU_SERVICE_COALESCE", "0") == "1"
+    )
+    # how long a BATCH-priority run may wait past submit for coalesce
+    # peers to arrive (seconds, measured on the service's injected
+    # clock); INTERACTIVE and STANDARD never wait. 0 = group only with
+    # what is already queued
+    service_coalesce_window_s: float = float(
+        os.environ.get("DEEQU_TPU_SERVICE_COALESCE_WINDOW", 0) or 0
+    )
+    # ceiling on runs per superset scan (bounds merged-plan op count
+    # and one failed group's blast radius)
+    service_coalesce_max_members: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_COALESCE_MAX_MEMBERS", 8) or 8
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

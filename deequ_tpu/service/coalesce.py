@@ -1,0 +1,92 @@
+"""Scan-coalescing policy: which queued runs may share one traversal.
+
+Scan-sharing is the engine's core trick (N analyzers fuse into one
+pass), but it stopped at the run boundary: N tenants verifying the same
+shared table still paid N full scans. The coalescer extends sharing
+across runs — when compatible queued tickets target the same
+``dataset_key``, the queue hands the worker a GROUP, the service runs
+ONE superset scan, and each tenant's ``AnalyzerContext`` is sliced back
+out (``AnalyzerContext.subset``; states are monoids, so a superset
+scan's states project onto each suite's subset by construction).
+
+This module is the pure POLICY half — no locks, no telemetry, no time
+reads of its own (the queue passes its injected clock's ``now``):
+
+- **compatibility** — same ``dataset_key`` and same config-derived
+  plan-key surface (``engine.scan.coalesce_key_surface``, captured onto
+  each ticket at submit). Incompatible runs simply don't coalesce.
+- **priority** — INTERACTIVE never waits and never coalesces (its
+  latency contract is the interactive reserve's whole point); STANDARD
+  coalesces opportunistically (joins whatever is already queued, never
+  waits for more); BATCH may additionally WAIT up to ``window_s`` after
+  submit for peers to arrive, bounding the added latency by the window.
+- **grouping atomicity** lives in ``RunQueue._take_group_locked`` —
+  host selection and member absorption happen in one critical section,
+  so concurrent idle workers can never each grab one member of a
+  would-be group.
+
+Every member keeps its own ``RunHandle``, submit-pinned deadline,
+journal records, and telemetry run summary; a superset-scan failure
+degrades to independent per-member execution in the service layer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from deequ_tpu.service.queue import Priority, RunTicket
+
+
+@dataclass(frozen=True)
+class CoalescePolicy:
+    """Grouping rules evaluated by the queue under its own lock."""
+
+    enabled: bool = False
+    # how long a BATCH ticket may sit past submit waiting for peers
+    # (0 = take immediately; only ever compared against the queue's
+    # injected clock, never wall time)
+    window_s: float = 0.0
+    # ceiling on tickets per superset scan — bounds both the merged
+    # plan's op count and the blast radius of one failed group
+    max_members: int = 8
+
+    def may_coalesce(self, ticket: RunTicket) -> bool:
+        """INTERACTIVE runs neither host nor join a group: a superset
+        scan's wall time is the max over members, and an interactive
+        run must never inherit a batch suite's runtime."""
+        return ticket.handle.priority > Priority.INTERACTIVE
+
+    def compatible(
+        self, host: RunTicket, candidate: RunTicket
+    ) -> Optional[str]:
+        """Why ``candidate`` must NOT join ``host``'s scan, or None.
+        Surfaces are compared by equality — both unset (tickets pushed
+        outside the service) is equal, matching the queue's trust in
+        its producer."""
+        if host.dataset_key is None or candidate.dataset_key is None:
+            return "no dataset key"
+        if host.dataset_key != candidate.dataset_key:
+            return (
+                f"dataset_key {host.dataset_key!r} != "
+                f"{candidate.dataset_key!r}"
+            )
+        if host.coalesce_surface != candidate.coalesce_surface:
+            return "config plan-key surface differs"
+        return None
+
+    def should_wait(
+        self, ticket: RunTicket, now: float, compatible_peers: int
+    ) -> bool:
+        """True when ``ticket`` should stay queued a little longer to
+        let more peers arrive: BATCH class, window still open, and the
+        group it could form is not already at ``max_members``. STANDARD
+        and INTERACTIVE never wait — they coalesce only with whatever
+        is already there when a worker frees up."""
+        if not self.enabled or self.window_s <= 0:
+            return False
+        if ticket.handle.priority < Priority.BATCH:
+            return False
+        if compatible_peers + 1 >= max(1, self.max_members):
+            return False
+        return (now - ticket.submitted_at) < self.window_s

@@ -199,6 +199,11 @@ class RunTicket:
     estimated_bytes: int = 0
     dataset_key: Optional[str] = None
     submitted_at: float = 0.0
+    # config-derived plan-key surface captured at submit
+    # (engine.scan.coalesce_key_surface): the coalescer only groups
+    # tickets with EQUAL surfaces, so a config change between two
+    # submissions can't smuggle differently-planned runs into one scan
+    coalesce_surface: Optional[tuple] = None
 
     @property
     def sort_key(self):
@@ -305,35 +310,99 @@ class RunQueue:
         """Best live ticket this worker may take, or None. Must hold
         the lock. Scans in (priority, seq) order; resolves dead tickets
         and skips tenants at their active quota."""
-        best: Optional[RunTicket] = None
+        group = self._take_group_locked(max_priority, None)
+        return group[0] if group else None
+
+    def _at_active_quota_locked(
+        self, tenant: str, taking: Dict[str, int]
+    ) -> bool:
+        """Would taking one more ticket for ``tenant`` (on top of the
+        ``taking`` counts already claimed by this group) breach the
+        active quota? Must hold the lock."""
+        if self.tenant_max_active <= 0:
+            return False
+        active = self._active_by_tenant.get(tenant, 0)
+        return active + taking.get(tenant, 0) >= self.tenant_max_active
+
+    def _take_group_locked(
+        self, max_priority: Optional[int], policy: Optional[Any]
+    ) -> Optional[List[RunTicket]]:
+        """Best live ticket this worker may take PLUS every compatible
+        queued ticket the coalesce policy lets it absorb — one critical
+        section, so concurrent idle workers can never each grab one
+        member of a would-be group (with workers >= tenants nothing
+        would ever coalesce otherwise). ``policy=None`` (or disabled)
+        degrades to plain single-ticket selection. Must hold the lock.
+
+        Held-back tickets (BATCH inside its coalesce window) are
+        skipped as HOSTS but remain absorbable as MEMBERS: a peer
+        popping first collects them; otherwise the window expires and
+        the next scan takes them normally."""
+        coalescing = policy is not None and getattr(
+            policy, "enabled", False
+        )
+        now = self.clock.now() if coalescing else 0.0
+        live: List[RunTicket] = []
         dead: List[RunTicket] = []
         for ticket in self._queued:
             if self._resolve_dead(ticket):
                 dead.append(ticket)
-                continue
+            else:
+                live.append(ticket)
+        for ticket in dead:
+            self._remove_locked(ticket)
+        taking: Dict[str, int] = {}
+        best: Optional[RunTicket] = None
+        for ticket in live:
             if max_priority is not None and (
                 ticket.handle.priority > max_priority
             ):
                 continue
-            if self.tenant_max_active > 0 and (
-                self._active_by_tenant.get(ticket.handle.tenant, 0)
-                >= self.tenant_max_active
-            ):
+            if self._at_active_quota_locked(ticket.handle.tenant, taking):
                 continue
+            if coalescing and policy.may_coalesce(ticket):
+                peers = sum(
+                    1
+                    for other in live
+                    if other is not ticket
+                    and policy.compatible(ticket, other) is None
+                )
+                if policy.should_wait(ticket, now, peers):
+                    continue
             if best is None or ticket.sort_key < best.sort_key:
                 best = ticket
-        for ticket in dead:
-            self._remove_locked(ticket)
-        if best is not None:
-            self._queued.remove(best)
-            tenant = best.handle.tenant
+        if best is None:
+            return None
+        group = [best]
+        taking[best.handle.tenant] = 1
+        if coalescing and policy.may_coalesce(best):
+            for ticket in sorted(
+                (t for t in live if t is not best),
+                key=lambda t: t.sort_key,
+            ):
+                if len(group) >= max(1, int(policy.max_members)):
+                    break
+                if not policy.may_coalesce(ticket):
+                    continue
+                if self._at_active_quota_locked(
+                    ticket.handle.tenant, taking
+                ):
+                    continue
+                if policy.compatible(best, ticket) is None:
+                    group.append(ticket)
+                    taking[ticket.handle.tenant] = (
+                        taking.get(ticket.handle.tenant, 0) + 1
+                    )
+        for ticket in group:
+            self._queued.remove(ticket)
+            tenant = ticket.handle.tenant
             self._pending_by_tenant[tenant] = max(
                 0, self._pending_by_tenant.get(tenant, 0) - 1
             )
             self._active_by_tenant[tenant] = (
                 self._active_by_tenant.get(tenant, 0) + 1
             )
-        return best
+        return group
 
     def _remove_locked(self, ticket: RunTicket) -> None:
         if ticket in self._queued:
@@ -360,6 +429,32 @@ class RunQueue:
                         "service.queue_depth"
                     ).set(len(self._queued))
                     return ticket
+                if self._closed or (
+                    should_stop is not None and should_stop()
+                ):
+                    return None
+                self._cond.wait(timeout=self.clock.queue_poll_s())
+
+    def pop_group(
+        self,
+        max_priority: Optional[int] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
+        policy: Optional[Any] = None,
+    ) -> Optional[List[RunTicket]]:
+        """Like :meth:`pop`, but returns the best live ticket TOGETHER
+        with every compatible queued ticket the ``policy``
+        (service.coalesce.CoalescePolicy) lets it absorb — the group
+        that will share one superset scan. The caller owes one
+        :meth:`task_done` per returned ticket. ``policy=None`` behaves
+        exactly like ``pop`` wrapped in a one-element list."""
+        while True:
+            with self._cond:
+                group = self._take_group_locked(max_priority, policy)
+                if group:
+                    get_telemetry().metrics.gauge(
+                        "service.queue_depth"
+                    ).set(len(self._queued))
+                    return group
                 if self._closed or (
                     should_stop is not None and should_stop()
                 ):

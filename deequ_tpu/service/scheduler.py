@@ -14,8 +14,16 @@ closure that leases the shared dataset and drives
 ``VerificationSuite.do_verification_run`` through the admission layer;
 fake-clock tests pass stubs that advance a ``ManualClock`` instead of
 doing work. The scheduler itself therefore never needs real time — its
-only blocking wait is ``RunQueue.pop``, which polls at the injected
-clock's cadence.
+only blocking wait is ``RunQueue.pop_group``, which polls at the
+injected clock's cadence.
+
+Scan coalescing (docs/SERVICE.md "Scan coalescing"): with a
+``coalesce`` policy attached and an ``execute_group`` callable
+injected, workers pop GROUPS of compatible tickets
+(``RunQueue.pop_group`` forms them atomically under the queue lock)
+and the group shares one superset scan; every member keeps its own
+handle, timeline, events, and terminal transition — the fan-out below
+applies the exact same finish semantics per member as a solo run.
 """
 
 from __future__ import annotations
@@ -26,6 +34,8 @@ from typing import Any, Callable, List, Optional
 from deequ_tpu.engine.deadline import MonotonicClock
 from deequ_tpu.service.queue import Priority, RunQueue, RunState, RunTicket
 from deequ_tpu.telemetry import get_telemetry
+
+QUEUE_WAIT_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
 
 
 class Scheduler:
@@ -39,9 +49,19 @@ class Scheduler:
         workers: int = 2,
         interactive_reserve: int = 1,
         clock: Any = None,
+        execute_group: Optional[
+            Callable[[List[RunTicket]], List[Any]]
+        ] = None,
+        coalesce: Optional[Any] = None,
     ):
         self.queue = queue
         self.execute = execute
+        # superset-scan executor: takes the whole group, returns one
+        # outcome PER MEMBER in order (a VerificationResult, or an
+        # exception instance for a member that failed individually).
+        # Without it, groups never form (the policy is ignored).
+        self.execute_group = execute_group
+        self.coalesce = coalesce if execute_group is not None else None
         self.workers = max(1, int(workers))
         # at least one general worker must remain or BATCH/STANDARD
         # work could never run at all
@@ -87,81 +107,120 @@ class Scheduler:
     def running(self) -> bool:
         return any(t.is_alive() for t in self._threads)
 
+    # -- per-ticket bookkeeping -----------------------------------------
+
+    def _mark_started(self, ticket: RunTicket, group_size: int) -> None:
+        tm = get_telemetry()
+        handle = ticket.handle
+        handle.started_at = self.clock.now()
+        wait_s = max(0.0, handle.started_at - ticket.submitted_at)
+        tm.metrics.histogram(
+            "service.queue_wait_s", buckets=QUEUE_WAIT_BUCKETS
+        ).observe(wait_s)
+        # per-class split: the coalescing bench's "INTERACTIVE p99
+        # unharmed" criterion needs waits attributable by class
+        tm.metrics.histogram(
+            f"service.queue_wait_s.{Priority.name(handle.priority)}",
+            buckets=QUEUE_WAIT_BUCKETS,
+        ).observe(wait_s)
+        handle._mark_running()
+        tm.event(
+            "service_run_started",
+            run_id=handle.run_id,
+            tenant=handle.tenant,
+            priority=Priority.name(handle.priority),
+            queue_wait_s=round(wait_s, 6),
+            coalesced=group_size > 1,
+        )
+
+    def _finish_failed(self, ticket: RunTicket, exc: BaseException) -> None:
+        tm = get_telemetry()
+        handle = ticket.handle
+        handle.finished_at = self.clock.now()
+        handle._finish(RunState.FAILED, error=exc)
+        tm.counter("service.failed").inc()
+        tm.event(
+            "service_run_finished",
+            run_id=handle.run_id,
+            tenant=handle.tenant,
+            priority=Priority.name(handle.priority),
+            status="failed",
+            error=repr(exc),
+        )
+
+    def _finish_result(self, ticket: RunTicket, result: Any) -> None:
+        tm = get_telemetry()
+        handle = ticket.handle
+        handle.finished_at = self.clock.now()
+        interruption = getattr(result, "interruption", None)
+        cancelled = (
+            interruption is not None
+            and getattr(interruption, "kind", "") != "deadline"
+        )
+        handle._finish(
+            RunState.CANCELLED if cancelled else RunState.DONE,
+            result=result,
+        )
+        tm.counter("service.completed").inc()
+        tm.counter(f"service.tenant.{handle.tenant}.runs").inc()
+        tm.event(
+            "service_run_finished",
+            run_id=handle.run_id,
+            tenant=handle.tenant,
+            priority=Priority.name(handle.priority),
+            status=(
+                "cancelled" if cancelled else str(
+                    getattr(
+                        getattr(result, "status", None), "value", "done"
+                    )
+                )
+            ),
+            wall_s=round(handle.finished_at - handle.started_at, 6),
+            interrupted=interruption is not None,
+        )
+
+    def _finish_outcome(self, ticket: RunTicket, outcome: Any) -> None:
+        """Apply a per-member group outcome through the same terminal
+        semantics as a solo run: exception instances fail the member,
+        anything else is its result."""
+        if isinstance(outcome, BaseException):
+            self._finish_failed(ticket, outcome)
+        else:
+            self._finish_result(ticket, outcome)
+
     # -- the worker loop ------------------------------------------------
 
     def _worker_loop(self, max_priority: Optional[int]) -> None:
-        tm = get_telemetry()
         while not self._stop.is_set():
-            ticket = self.queue.pop(
+            group = self.queue.pop_group(
                 max_priority=max_priority,
                 should_stop=self._stop.is_set,
+                policy=self.coalesce,
             )
-            if ticket is None:
+            if group is None:
                 return  # queue closed or scheduler stopping
-            handle = ticket.handle
-            handle.started_at = self.clock.now()
-            wait_s = max(0.0, handle.started_at - ticket.submitted_at)
-            tm.metrics.histogram(
-                "service.queue_wait_s",
-                buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0),
-            ).observe(wait_s)
-            handle._mark_running()
-            tm.event(
-                "service_run_started",
-                run_id=handle.run_id,
-                tenant=handle.tenant,
-                priority=Priority.name(handle.priority),
-                queue_wait_s=round(wait_s, 6),
-            )
+            for ticket in group:
+                self._mark_started(ticket, len(group))
             try:
-                result = self.execute(ticket)
-            # lint-ok: interrupt-swallow: the handle is the error
+                if len(group) == 1:
+                    outcomes: List[Any] = [self.execute(group[0])]
+                else:
+                    outcomes = list(self.execute_group(group))
+                    if len(outcomes) != len(group):
+                        raise RuntimeError(
+                            f"execute_group returned {len(outcomes)} "
+                            f"outcomes for {len(group)} tickets"
+                        )
+            # lint-ok: interrupt-swallow: the handles are the error
             # channel — _finish(FAILED, error=exc) carries everything
             # (interrupts included) to result(); the worker thread
             # itself must survive any run
             except BaseException as exc:  # noqa: BLE001
-                handle.finished_at = self.clock.now()
-                handle._finish(RunState.FAILED, error=exc)
-                tm.counter("service.failed").inc()
-                tm.event(
-                    "service_run_finished",
-                    run_id=handle.run_id,
-                    tenant=handle.tenant,
-                    priority=Priority.name(handle.priority),
-                    status="failed",
-                    error=repr(exc),
-                )
+                for ticket in group:
+                    self._finish_failed(ticket, exc)
             else:
-                handle.finished_at = self.clock.now()
-                interruption = getattr(result, "interruption", None)
-                cancelled = (
-                    interruption is not None
-                    and getattr(interruption, "kind", "") != "deadline"
-                )
-                handle._finish(
-                    RunState.CANCELLED if cancelled else RunState.DONE,
-                    result=result,
-                )
-                tm.counter("service.completed").inc()
-                tm.counter(f"service.tenant.{handle.tenant}.runs").inc()
-                tm.event(
-                    "service_run_finished",
-                    run_id=handle.run_id,
-                    tenant=handle.tenant,
-                    priority=Priority.name(handle.priority),
-                    status=(
-                        "cancelled" if cancelled else str(
-                            getattr(
-                                getattr(result, "status", None),
-                                "value",
-                                "done",
-                            )
-                        )
-                    ),
-                    wall_s=round(
-                        handle.finished_at - handle.started_at, 6
-                    ),
-                    interrupted=interruption is not None,
-                )
+                for ticket, outcome in zip(group, outcomes):
+                    self._finish_outcome(ticket, outcome)
             finally:
-                self.queue.task_done(ticket)
+                for ticket in group:
+                    self.queue.task_done(ticket)

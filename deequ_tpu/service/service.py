@@ -89,7 +89,14 @@ class RunRequest:
             ds = self.dataset
             self.dataset_factory = lambda: ds
             if self.dataset_key is None:
-                self.dataset_key = f"dataset-{id(ds):x}"
+                # content-derived default: two submissions of the SAME
+                # in-memory table share the cache entry and may
+                # coalesce — an id()-based key defeated both (every
+                # rebuilt Dataset object was its own cache universe)
+                try:
+                    self.dataset_key = f"dataset-{ds.fingerprint()}"
+                except Exception:  # noqa: BLE001 — unfingerprintable
+                    self.dataset_key = f"dataset-{id(ds):x}"
         if self.dataset_key is None or self.dataset_factory is None:
             raise ValueError(
                 "RunRequest needs dataset_key + dataset_factory "
@@ -117,6 +124,12 @@ class VerificationService:
         shed_queue_depth: Optional[int] = None,
         shed_crash_rate: Optional[int] = None,
         shed_crash_window_s: Optional[float] = None,
+        coalesce: Optional[bool] = None,
+        coalesce_window_s: Optional[float] = None,
+        coalesce_max_members: Optional[int] = None,
+        execute_group: Optional[
+            Callable[[List[RunTicket]], List[Any]]
+        ] = None,
     ):
         from deequ_tpu import config
 
@@ -176,6 +189,33 @@ class VerificationService:
                 else opts.service_tenant_max_active
             ),
         )
+        # scan coalescing (docs/SERVICE.md "Scan coalescing"): opt-in;
+        # the group executor defaults to the service's own ONLY when
+        # the solo executor is also the service's own — an injected
+        # `execute=` stub (fake-clock tests) keeps strict solo
+        # semantics unless it injects `execute_group=` too
+        coalesce_on = bool(
+            opts.service_coalesce if coalesce is None else coalesce
+        )
+        if execute_group is None and execute is None:
+            execute_group = self._execute_group
+        self.coalesce_policy = None
+        if coalesce_on and execute_group is not None:
+            from deequ_tpu.service.coalesce import CoalescePolicy
+
+            self.coalesce_policy = CoalescePolicy(
+                enabled=True,
+                window_s=float(
+                    opts.service_coalesce_window_s
+                    if coalesce_window_s is None
+                    else coalesce_window_s
+                ),
+                max_members=int(
+                    opts.service_coalesce_max_members
+                    if coalesce_max_members is None
+                    else coalesce_max_members
+                ),
+            )
         self.scheduler = Scheduler(
             self.queue,
             execute if execute is not None else self._execute,
@@ -188,6 +228,8 @@ class VerificationService:
                 else opts.service_interactive_reserve
             ),
             clock=self.clock,
+            execute_group=execute_group,
+            coalesce=self.coalesce_policy,
         )
         self._run_seq = 0
         self._handles: Dict[str, RunHandle] = {}
@@ -305,12 +347,22 @@ class VerificationService:
             budget = RunBudget(
                 deadline_s=float(request.deadline_s), clock=self.clock
             )
+        surface = None
+        if self.coalesce_policy is not None:
+            # submit-time capture: the coalescer only groups tickets
+            # whose config-derived plan-key surfaces are EQUAL, so a
+            # config.configure(...) change between two submissions
+            # can't smuggle differently-planned runs into one scan
+            from deequ_tpu.engine.scan import coalesce_key_surface
+
+            surface = coalesce_key_surface()
         ticket = RunTicket(
             seq=0,  # assigned by the queue
             handle=handle,
             payload=request,
             budget=budget,
             dataset_key=request.dataset_key,
+            coalesce_surface=surface,
         )
         tm = get_telemetry()
         if self.journal is not None:
@@ -520,6 +572,11 @@ class VerificationService:
             self.journal.record_started(
                 ticket.handle.run_id, tenant=request.tenant
             )
+        return self._execute_solo(ticket)
+
+    def _execute_solo(self, ticket: RunTicket):
+        """Drive one already-journaled ticket (the solo path, and the
+        per-member fallback of a failed superset scan)."""
         if self.isolated:
             payload = self._isolation_payload(ticket)
             if payload is not None:
@@ -641,6 +698,227 @@ class VerificationService:
         self.plans.record_run(getattr(result, "telemetry", None))
         return result
 
+    # -- coalesced (superset-scan) execution -----------------------------
+
+    def _execute_group(self, tickets: List[RunTicket]) -> List[Any]:
+        """Execute a coalesced group: ONE superset scan over the shared
+        dataset, each member's ``VerificationResult`` sliced back out.
+        Returns one outcome per ticket in order (a result, or an
+        exception instance for a member that failed individually). A
+        superset-scan failure degrades to independent per-member
+        execution; a crash-looped isolated superset floors EVERY member
+        with the crash provenance."""
+        tm = get_telemetry()
+        host = tickets[0]
+        run_ids = [t.handle.run_id for t in tickets]
+        if self.journal is not None:
+            for ticket in tickets:
+                self.journal.record_started(
+                    ticket.handle.run_id, tenant=ticket.payload.tenant
+                )
+        tm.counter("service.coalesced_scans").inc()
+        tm.counter("service.runs_coalesced").inc(len(tickets))
+        # the whole point, as a counter: K runs, K-1 traversals NOT made
+        tm.counter("service.scan_passes_saved").inc(len(tickets) - 1)
+        waits = [
+            max(0.0, (t.handle.started_at or 0.0) - t.submitted_at)
+            for t in tickets
+        ]
+        tm.event(
+            "runs_coalesced",
+            dataset_key=host.dataset_key,
+            members=len(tickets),
+            run_ids=",".join(run_ids),
+            tenants=",".join(
+                sorted({t.payload.tenant for t in tickets})
+            ),
+            queue_wait_s_max=round(max(waits), 6) if waits else 0.0,
+        )
+        if self.isolated:
+            payload = self._group_isolation_payload(tickets)
+            if payload is not None:
+                return self._execute_group_isolated(tickets, payload)
+            tm.counter("service.isolation_inline_fallbacks").inc()
+            tm.event(
+                "service_isolation_fallback",
+                run_id=",".join(run_ids),
+                reason="coalesced group does not pickle; executing "
+                "in-process",
+            )
+        return self._execute_group_inline(tickets)
+
+    def _execute_group_inline(self, tickets: List[RunTicket]) -> List[Any]:
+        from deequ_tpu.verification.suite import VerificationSuite
+
+        host = tickets[0]
+        request: RunRequest = host.payload
+        dataset, hit = self.datasets.lease(
+            request.dataset_key, request.dataset_factory
+        )
+        get_telemetry().event(
+            "service_dataset_leased",
+            run_id=host.handle.run_id,
+            dataset_key=request.dataset_key,
+            cache_hit=hit,
+            coalesced_members=len(tickets),
+        )
+        engine = None
+        if self._checkpoint_path is not None:
+            from deequ_tpu.engine.scan import AnalysisEngine
+
+            engine = AnalysisEngine(
+                checkpointer=_JournalingCheckpointer(
+                    self._checkpoint_path,
+                    self.journal,
+                    host.handle.run_id,
+                )
+            )
+        try:
+            # the superset scan runs under the HOST's envelope (best
+            # priority, earliest seq). Member deadlines governed queue
+            # wait (resolved at pop); a member cancel landing after
+            # the scan began does NOT stop the group — the member
+            # still receives its complete sliced result
+            results = VerificationSuite.do_coalesced_verification_run(
+                dataset,
+                [
+                    (
+                        list(t.payload.checks),
+                        list(t.payload.required_analyzers),
+                    )
+                    for t in tickets
+                ],
+                engine=engine,
+                deadline=host.budget,
+            )
+        # lint-ok: interrupt-swallow: degradation to independent
+        # per-member execution — each member's own path re-raises into
+        # its outcome slot, nothing is lost
+        except BaseException as exc:  # noqa: BLE001
+            return self._execute_members_independently(tickets, exc)
+        finally:
+            self.datasets.release(request.dataset_key)
+        for ticket, result in zip(tickets, results):
+            member: RunRequest = ticket.payload
+            if (
+                member.metrics_repository is not None
+                and member.result_key is not None
+            ):
+                _persist_member_result(
+                    member.metrics_repository, member.result_key, result
+                )
+        self.plans.record_run(getattr(results[0], "telemetry", None))
+        return list(results)
+
+    def _execute_members_independently(
+        self, tickets: List[RunTicket], cause: BaseException
+    ) -> List[Any]:
+        """Superset-scan failure fan-out: re-run every member solo so
+        one bad union never fails N tenants. Per-member outcomes are
+        results or that member's OWN exception."""
+        tm = get_telemetry()
+        tm.counter("service.coalesce_fallbacks").inc()
+        tm.event(
+            "coalesce_fallback",
+            dataset_key=tickets[0].dataset_key,
+            members=len(tickets),
+            error=repr(cause)[:500],
+        )
+        outcomes: List[Any] = []
+        for ticket in tickets:
+            try:
+                outcomes.append(self._execute_solo(ticket))
+            # lint-ok: interrupt-swallow: the outcome slot is the error
+            # channel — the scheduler fans it into the member's handle
+            except BaseException as exc:  # noqa: BLE001
+                outcomes.append(exc)
+        return outcomes
+
+    def _group_isolation_payload(
+        self, tickets: List[RunTicket]
+    ) -> Optional[Dict[str, Any]]:
+        host: RunRequest = tickets[0].payload
+        payload = {
+            "run_ids": [t.handle.run_id for t in tickets],
+            "dataset_key": host.dataset_key,
+            "dataset_factory": host.dataset_factory,
+            "members": [
+                {
+                    "checks": list(t.payload.checks),
+                    "required_analyzers": list(
+                        t.payload.required_analyzers
+                    ),
+                }
+                for t in tickets
+            ],
+            "checkpoint_path": self._checkpoint_path,
+            "deadline_s": (
+                tickets[0].budget.remaining()
+                if tickets[0].budget is not None
+                else None
+            ),
+        }
+        try:
+            pickle.dumps(payload)
+        except Exception:  # noqa: BLE001 — any closure anywhere inside
+            return None
+        return payload
+
+    def _execute_group_isolated(
+        self, tickets: List[RunTicket], payload: Dict[str, Any]
+    ) -> List[Any]:
+        from deequ_tpu.engine.subproc import checkpoint_progress_probe
+
+        host = tickets[0]
+        request: RunRequest = host.payload
+        probe = (
+            checkpoint_progress_probe(self._checkpoint_path)
+            if self._checkpoint_path is not None
+            else None
+        )
+        runner = IsolatedRunner(
+            key=f"dataset:{request.dataset_key}",
+            progress_probe=probe,
+            timeout_s=(
+                host.budget.remaining()
+                if host.budget is not None
+                else None
+            ),
+            clock=self.clock,
+        )
+        try:
+            results = runner.run(_isolated_execute_coalesced, payload)
+        except CrashLoopError as exc:
+            self._note_crash()
+            from deequ_tpu import config
+
+            policy = config.options().degradation_policy
+            # crash-loop flooring lands on EVERY member with the same
+            # provenance: under "fail" each handle fails with the
+            # CrashLoopError; under warn/tolerate each member gets its
+            # own floored empty result carrying the crash record
+            if policy == "fail":
+                return [exc for _ in tickets]
+            return [_crash_loop_result(exc, policy) for _ in tickets]
+        # lint-ok: interrupt-swallow: degradation to independent
+        # per-member execution; member paths re-raise into outcome slots
+        except BaseException as exc:  # noqa: BLE001
+            return self._execute_members_independently(tickets, exc)
+        for ticket, result in zip(tickets, results):
+            member: RunRequest = ticket.payload
+            if (
+                isinstance(result, Exception)
+                or member.metrics_repository is None
+                or member.result_key is None
+            ):
+                continue
+            _persist_member_result(
+                member.metrics_repository, member.result_key, result
+            )
+        if results and not isinstance(results[0], Exception):
+            self.plans.record_run(getattr(results[0], "telemetry", None))
+        return list(results)
+
     # -- introspection --------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
@@ -705,6 +983,68 @@ def _isolated_execute(payload: Dict[str, Any]):
     )
     result._data = None
     return result
+
+
+def _isolated_execute_coalesced(payload: Dict[str, Any]) -> List[Any]:
+    """Child-process entry for one coalesced superset scan (module
+    level: spawn pickles it by reference). Rebuilds the shared dataset
+    ONCE, runs the single superset traversal, and returns the member
+    results in order — each stripped of ``_data`` (device buffers do
+    not cross the pipe)."""
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    engine = None
+    if payload.get("checkpoint_path"):
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        engine = AnalysisEngine(
+            checkpointer=ScanCheckpointer(payload["checkpoint_path"])
+        )
+    dataset = payload["dataset_factory"]()
+    results = VerificationSuite.do_coalesced_verification_run(
+        dataset,
+        [
+            (member["checks"], member["required_analyzers"])
+            for member in payload["members"]
+        ],
+        engine=engine,
+        deadline=payload.get("deadline_s"),
+    )
+    for result in results:
+        result._data = None
+    return results
+
+
+def _persist_member_result(repository, key, result) -> None:
+    """Append one coalesced member's sliced result to its metrics
+    repository — the same load/combine/save (with operational records)
+    that ``do_analysis_run`` performs for a solo run. The coalesced
+    path cannot delegate persistence to the superset run: each member
+    owns a DIFFERENT repository/key pair and only its own slice."""
+    from deequ_tpu.analyzers.runner import AnalyzerContext
+    from deequ_tpu.repository.base import AnalysisResult
+
+    context = AnalyzerContext(
+        dict(result.metrics),
+        run_metadata=result.run_metadata,
+        telemetry=result.telemetry,
+        degradation=result.degradation,
+        interruption=result.interruption,
+    )
+    current = repository.load_by_key(key)
+    combined = (
+        current.analyzer_context + context
+        if current is not None
+        else context
+    )
+    summary = result.telemetry
+    if summary is not None:
+        from deequ_tpu.telemetry.oprecords import operational_metrics
+
+        op = operational_metrics(summary)
+        if op:
+            combined = combined + AnalyzerContext(op)
+    repository.save(AnalysisResult(key, combined))
 
 
 def _crash_loop_result(exc: CrashLoopError, policy: str):

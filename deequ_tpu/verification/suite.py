@@ -158,6 +158,39 @@ class VerificationSuite:
         return VerificationSuite.evaluate(checks, context, data=data)
 
     @staticmethod
+    def do_coalesced_verification_run(
+        data: Dataset,
+        members: Sequence[Any],
+        engine: Optional[AnalysisEngine] = None,
+        deadline=None,
+        cancel=None,
+    ) -> List[VerificationResult]:
+        """One scan, many suites: each member is a ``(checks,
+        required_analyzers)`` pair; their analyzer sets are unioned
+        into ONE superset analysis run (a single traversal of ``data``)
+        and each member's checks are evaluated against its own sliced
+        context (``AnalyzerContext.subset``) — metric-for-metric what a
+        solo ``do_verification_run`` of that member would produce
+        (pinned differentially in tests/test_coalesce.py). Returns one
+        ``VerificationResult`` per member, in order; shared scan
+        provenance (degradation/interruption/telemetry) rides every
+        member's result. The service-side scan coalescer
+        (docs/SERVICE.md "Scan coalescing") drives this."""
+        suites = []
+        for checks, required_analyzers in members:
+            suites.append(
+                list(required_analyzers)
+                + [a for check in checks for a in check.required_analyzers()]
+            )
+        contexts = AnalysisRunner.do_coalesced_analysis_run(
+            data, suites, engine=engine, deadline=deadline, cancel=cancel
+        )
+        return [
+            VerificationSuite.evaluate(list(checks), context, data=data)
+            for (checks, _), context in zip(members, contexts)
+        ]
+
+    @staticmethod
     def install_graceful_shutdown(signals=None):
         """Opt-in SIGTERM handling: maps process shutdown onto the
         process-wide shutdown ``CancelToken``, so every supervised run

@@ -1,0 +1,714 @@
+"""Scan coalescing (PR 12, ROADMAP item 2): sliceable
+``AnalyzerContext`` (subset-of-superset == solo, differentially),
+composable ``ScanPlan``s (``merge_plans``/``plan_diff``), the queue's
+atomic group formation under the coalesce policy, and the service-side
+coalescer end to end — K tenant suites over one dataset key share ONE
+superset traversal (``engine.data_passes`` pinned), every member's
+metrics bit-equal to an independent run, with degradation to
+independent execution when the superset scan fails and crash-loop
+flooring on every member under isolation."""
+
+import multiprocessing
+import threading
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    AnalysisRunner,
+    AnalyzerContext,
+    ApproxCountDistinct,
+    ApproxQuantile,
+    Completeness,
+    Compliance,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.engine import AnalysisEngine
+from deequ_tpu.engine.deadline import ManualClock
+from deequ_tpu.engine.subproc import CrashLoopError, reset_breakers
+from deequ_tpu.engine.scan import (
+    coalesce_key_surface,
+    merge_plans,
+    plan_compatibility,
+    plan_diff,
+)
+from deequ_tpu.repository.base import InMemoryMetricsRepository, ResultKey
+from deequ_tpu.service import (
+    Priority,
+    RunHandle,
+    RunQueue,
+    RunRequest,
+    RunState,
+    RunTicket,
+    VerificationService,
+)
+from deequ_tpu.service import service as service_module
+from deequ_tpu.service.coalesce import CoalescePolicy
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.verification.suite import VerificationSuite
+
+
+@pytest.fixture(autouse=True)
+def _reaped_and_reset():
+    reset_breakers()
+    yield
+    assert multiprocessing.active_children() == []
+    reset_breakers()
+
+
+def _table(n=4_000, seed=11) -> Dataset:
+    rng = np.random.default_rng(seed)
+    return Dataset.from_pydict(
+        {
+            "a": rng.integers(0, 500, n, dtype=np.int64).tolist(),
+            "b": rng.normal(10.0, 3.0, n).tolist(),
+            "g": (np.arange(n) % 13).tolist(),
+        }
+    )
+
+
+def _values(context: AnalyzerContext):
+    out = {}
+    for analyzer, metric in context.metric_map.items():
+        assert metric.value.is_success, (analyzer, metric.value)
+        out[analyzer.identity_key] = metric.value.get()
+    return out
+
+
+def _assert_equal_values(sliced, solo):
+    assert sliced.keys() == solo.keys()
+    for key in solo:
+        a, b = sliced[key], solo[key]
+        if isinstance(a, float) and isinstance(b, float):
+            # bit-equal: the superset scan runs the SAME fused update
+            # over the same batches — no reassociation to forgive
+            assert a == b, (key, a, b)
+        else:
+            assert a == b, (key, a, b)
+
+
+# --------------------------------------------------------------------------
+# Satellite 1: AnalyzerContext.subset — subset-of-superset == solo
+# --------------------------------------------------------------------------
+
+
+class TestContextSubset:
+    SUITE = [Completeness("a"), Mean("b"), Minimum("b"), Size()]
+    EXTRA = [
+        Maximum("b"),
+        Sum("a"),
+        StandardDeviation("b"),
+        Compliance("pos", "b >= 0"),
+    ]
+
+    @pytest.mark.parametrize("streamed", [False, True])
+    def test_subset_of_superset_equals_solo(self, streamed):
+        data = _table()
+        overrides = (
+            {"device_cache_bytes": 0, "batch_size": 1_024}
+            if streamed
+            else {}
+        )
+        with config.configure(**overrides):
+            superset = AnalysisRunner.do_analysis_run(
+                data, self.SUITE + self.EXTRA, engine=AnalysisEngine()
+            )
+            solo = AnalysisRunner.do_analysis_run(
+                data, self.SUITE, engine=AnalysisEngine()
+            )
+        sliced = superset.subset(self.SUITE)
+        _assert_equal_values(_values(sliced), _values(solo))
+
+    def test_subset_grouping_spill_kll_hll(self):
+        """The stateful families too: grouping (frequency passes),
+        KLL/HLL sketches — slicing is by analyzer identity, whatever
+        machinery computed the metric."""
+        suite = [
+            Uniqueness(["a"]),
+            ApproxQuantile("b", 0.5),
+            ApproxCountDistinct("a"),
+            Histogram("g"),
+        ]
+        extra = [Uniqueness(["g"]), ApproxQuantile("b", 0.9), Mean("b")]
+        data = _table()
+        superset = AnalysisRunner.do_analysis_run(
+            data, suite + extra, engine=AnalysisEngine()
+        )
+        solo = AnalysisRunner.do_analysis_run(
+            data, suite, engine=AnalysisEngine()
+        )
+        sliced = superset.subset(suite)
+        assert _values(sliced).keys() == _values(solo).keys()
+        for key, value in _values(solo).items():
+            got = _values(sliced)[key]
+            if isinstance(value, (int, float)):
+                assert got == pytest.approx(value, rel=0, abs=0), key
+            else:
+                assert got == value, key
+
+    def test_subset_where_filtered_analyzers_distinct(self):
+        """A where-filtered analyzer is a DIFFERENT identity from its
+        unfiltered sibling; subset must never cross the two."""
+        data = _table()
+        plain = Completeness("a")
+        filtered = Completeness("a", where="b >= 10")
+        superset = AnalysisRunner.do_analysis_run(
+            data, [plain, filtered, Mean("b")], engine=AnalysisEngine()
+        )
+        only_filtered = superset.subset([filtered])
+        assert list(only_filtered.metric_map) == [filtered]
+        solo = AnalysisRunner.do_analysis_run(
+            data, [filtered], engine=AnalysisEngine()
+        )
+        _assert_equal_values(_values(only_filtered), _values(solo))
+
+    def test_identity_key_parameter_complete(self):
+        assert Completeness("a").identity_key != Completeness("b").identity_key
+        assert (
+            Completeness("a").identity_key
+            != Completeness("a", where="b > 0").identity_key
+        )
+        assert (
+            ApproxQuantile("b", 0.5).identity_key
+            != ApproxQuantile("b", 0.9).identity_key
+        )
+        assert Mean("a").identity_key == Mean("a").identity_key
+
+    def test_subset_carries_scan_provenance(self):
+        """Degradation/interruption describe the SHARED scan, so every
+        slice keeps them — a tenant must see that its metrics came from
+        a partial pass even when another tenant asked for the run."""
+        full = AnalysisRunner.do_analysis_run(
+            _table(n=256), [Mean("b"), Size()], engine=AnalysisEngine()
+        )
+        marker = object()
+        full.degradation = marker
+        full.interruption = marker
+        sliced = full.subset([Size()])
+        assert sliced.degradation is marker
+        assert sliced.interruption is marker
+        assert sliced.run_metadata is full.run_metadata
+        assert sliced.telemetry is full.telemetry
+
+    def test_coalesced_analysis_run_slices_per_suite(self):
+        data = _table()
+        suites = [
+            [Completeness("a"), Mean("b")],
+            [Mean("b"), Maximum("b")],
+            [Size()],
+        ]
+        contexts = AnalysisRunner.do_coalesced_analysis_run(
+            data, suites, engine=AnalysisEngine()
+        )
+        assert len(contexts) == 3
+        for suite, context in zip(suites, contexts):
+            solo = AnalysisRunner.do_analysis_run(
+                data, suite, engine=AnalysisEngine()
+            )
+            _assert_equal_values(_values(context), _values(solo))
+
+
+# --------------------------------------------------------------------------
+# Plan composability: merge_plans / plan_diff
+# --------------------------------------------------------------------------
+
+
+def _prepare(data, analyzers, engine=None):
+    from deequ_tpu.analyzers.runner import _plan_fused_pass
+
+    engine = engine or AnalysisEngine()
+    fused = _plan_fused_pass(data, list(analyzers), [], engine)
+    plan = engine.prepare_scan(data, fused.scan_pairs)
+    assert plan is not None
+    return plan
+
+
+class TestPlanMergeDiff:
+    def test_merge_dedups_shared_ops(self):
+        data = _table()
+        # the shared op must be BEHAVIOR-identical across plans: the
+        # vectorizer fuses same-column numeric stats, so Mean("b") solo
+        # and Mean+Minimum("b") fused carry different tokens and are
+        # (correctly) not dedupable — share the exact analyzer instead
+        plan_a = _prepare(data, [Completeness("a"), Mean("b")])
+        plan_b = _prepare(data, [Mean("b"), Completeness("g")])
+        merged = merge_plans(plan_a, plan_b)
+        assert plan_compatibility(plan_a, plan_b) is None
+        # the shared Mean("b") op pays ONE slot in the superset
+        assert len(merged.ops) < len(plan_a.ops) + len(plan_b.ops)
+        diff = plan_diff(plan_a, plan_b)
+        assert diff.mergeable
+        assert diff.savings >= 1
+        assert len(merged.ops) == (
+            len(plan_a.ops) + len(plan_b.ops) - diff.savings
+        )
+        # the merged plan is itself cacheable under a recomputed key
+        assert merged.cache_key is not None
+        assert merged.cache_key != plan_a.cache_key
+
+    def test_merge_incompatible_raises(self):
+        data = _table()
+        plan_a = _prepare(data, [Mean("b")], AnalysisEngine(batch_size=512))
+        plan_b = _prepare(
+            data, [Mean("b")], AnalysisEngine(batch_size=1_024)
+        )
+        reason = plan_compatibility(plan_a, plan_b)
+        assert reason is not None and "batch_size" in reason
+        assert not plan_diff(plan_a, plan_b).mergeable
+        with pytest.raises(ValueError, match="batch_size"):
+            merge_plans(plan_a, plan_b)
+
+    def test_merged_plan_executes_identically(self):
+        data = _table()
+        engine = AnalysisEngine()
+        plan_a = _prepare(data, [Mean("b"), Size()], engine)
+        plan_b = _prepare(data, [Mean("b"), Size()], engine)
+        merged = merge_plans(plan_a, plan_b)
+        assert len(merged.ops) == len(plan_a.ops)
+        states_merged = engine.execute_plan(merged, data)
+        states_solo = AnalysisEngine().execute_plan(plan_a, data)
+        import jax
+
+        for got, want in zip(states_merged, states_solo):
+            for leaf_g, leaf_w in zip(
+                jax.tree_util.tree_leaves(got),
+                jax.tree_util.tree_leaves(want),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(leaf_g), np.asarray(leaf_w)
+                )
+
+    def test_coalesce_key_surface_tracks_config(self):
+        base = coalesce_key_surface()
+        with config.configure(batch_size=77):
+            assert coalesce_key_surface() != base
+        assert coalesce_key_surface() == base
+
+
+# --------------------------------------------------------------------------
+# Queue: atomic group formation under the coalesce policy
+# --------------------------------------------------------------------------
+
+
+_SEQ = iter(range(10_000))
+
+
+def _ticket(
+    tenant="acme",
+    priority=Priority.BATCH,
+    run_id=None,
+    dataset_key="shared",
+    surface=("s",),
+    submitted_at=0.0,
+):
+    seq = next(_SEQ)
+    handle = RunHandle(run_id or f"run-{seq}", tenant, priority)
+    return RunTicket(
+        seq=seq,
+        handle=handle,
+        payload=None,
+        dataset_key=dataset_key,
+        submitted_at=submitted_at,
+        coalesce_surface=surface,
+    )
+
+
+def _policy(window_s=0.0, max_members=8):
+    return CoalescePolicy(
+        enabled=True, window_s=window_s, max_members=max_members
+    )
+
+
+class TestQueueGrouping:
+    def test_group_forms_atomically_from_coqueued(self):
+        q = RunQueue(clock=ManualClock())
+        tickets = [_ticket(tenant=f"t{i}") for i in range(3)]
+        for t in tickets:
+            q.push(t)
+        group = q.pop_group(should_stop=lambda: True, policy=_policy())
+        assert [t.handle.run_id for t in group] == [
+            t.handle.run_id for t in tickets
+        ]
+        assert q.depth() == 0
+        for t in group:
+            q.task_done(t)
+
+    def test_interactive_never_waits_never_coalesces(self):
+        q = RunQueue(clock=ManualClock())
+        inter = _ticket(priority=Priority.INTERACTIVE)
+        batch = _ticket(priority=Priority.BATCH)
+        q.push(batch)
+        q.push(inter)
+        # interactive pops FIRST (priority) and pops ALONE, even with a
+        # compatible batch ticket on the same key
+        group = q.pop_group(
+            should_stop=lambda: True, policy=_policy(window_s=100.0)
+        )
+        assert len(group) == 1
+        assert group[0] is inter
+
+    def test_window_holds_batch_for_peers_then_releases(self):
+        clock = ManualClock()
+        q = RunQueue(clock=clock)
+        lone = _ticket(submitted_at=clock.now())
+        q.push(lone)
+        policy = _policy(window_s=5.0)
+        # inside the window with room for more members: held back
+        assert q.pop_group(should_stop=lambda: True, policy=policy) is None
+        assert q.depth() == 1
+        # window expired: taken solo
+        clock.advance(6.0)
+        group = q.pop_group(should_stop=lambda: True, policy=policy)
+        assert [t for t in group] == [lone]
+
+    def test_window_releases_when_group_is_full(self):
+        clock = ManualClock()
+        q = RunQueue(clock=clock)
+        a = _ticket(submitted_at=clock.now())
+        b = _ticket(submitted_at=clock.now())
+        q.push(a)
+        q.push(b)
+        # max_members=2 and 2 compatible tickets: no point waiting
+        group = q.pop_group(
+            should_stop=lambda: True,
+            policy=_policy(window_s=100.0, max_members=2),
+        )
+        assert group is not None and len(group) == 2
+
+    def test_max_members_caps_group(self):
+        q = RunQueue(clock=ManualClock())
+        tickets = [_ticket() for _ in range(5)]
+        for t in tickets:
+            q.push(t)
+        group = q.pop_group(
+            should_stop=lambda: True, policy=_policy(max_members=3)
+        )
+        assert len(group) == 3
+        assert q.depth() == 2
+
+    def test_mismatched_key_or_surface_not_absorbed(self):
+        q = RunQueue(clock=ManualClock())
+        host = _ticket(dataset_key="k1", surface=("s1",))
+        other_key = _ticket(dataset_key="k2", surface=("s1",))
+        other_surface = _ticket(dataset_key="k1", surface=("s2",))
+        no_key = _ticket(dataset_key=None, surface=("s1",))
+        for t in (host, other_key, other_surface, no_key):
+            q.push(t)
+        group = q.pop_group(should_stop=lambda: True, policy=_policy())
+        assert group == [host]
+        assert q.depth() == 3
+
+    def test_tenant_active_quota_bounds_group(self):
+        q = RunQueue(clock=ManualClock(), tenant_max_active=1)
+        a1 = _ticket(tenant="acme")
+        a2 = _ticket(tenant="acme")
+        g1 = _ticket(tenant="globex")
+        for t in (a1, a2, g1):
+            q.push(t)
+        group = q.pop_group(should_stop=lambda: True, policy=_policy())
+        # acme's second ticket would breach its active quota inside the
+        # group too — quotas bound coalesced admission exactly like solo
+        assert group == [a1, g1]
+
+    def test_disabled_policy_degrades_to_solo_pop(self):
+        q = RunQueue(clock=ManualClock())
+        for _ in range(2):
+            q.push(_ticket())
+        group = q.pop_group(
+            should_stop=lambda: True,
+            policy=CoalescePolicy(enabled=False),
+        )
+        assert len(group) == 1
+
+
+# --------------------------------------------------------------------------
+# Service end to end: one pass, many tenants
+# --------------------------------------------------------------------------
+
+
+def _suite(i):
+    check = Check(CheckLevel.ERROR, f"tenant-{i}").is_complete("a")
+    if i % 2 == 0:
+        check = check.is_non_negative("a")
+    else:
+        check = check.is_complete("b")
+    return [check]
+
+
+class TestServiceCoalescing:
+    def _submit_all_then_start(self, svc, n, **request_kwargs):
+        handles = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"t{i}",
+                    checks=_suite(i),
+                    dataset_key="shared/coalesce",
+                    dataset_factory=lambda: _table(),
+                    priority=Priority.BATCH,
+                    **request_kwargs,
+                )
+            )
+            for i in range(n)
+        ]
+        svc.start()
+        return handles
+
+    def test_one_pass_metrics_equal_independent(self):
+        tm = get_telemetry()
+        solo = [
+            VerificationSuite.do_verification_run(_table(), _suite(i))
+            for i in range(3)
+        ]
+        passes_before = tm.counter("engine.data_passes").value
+        coalesced_before = tm.counter("service.runs_coalesced").value
+        saved_before = tm.counter("service.scan_passes_saved").value
+        svc = VerificationService(
+            workers=2,
+            interactive_reserve=1,
+            coalesce=True,
+            coalesce_window_s=0.0,
+        )
+        handles = self._submit_all_then_start(svc, 3)
+        try:
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            svc.stop(drain=False, timeout=30)
+        # THE acceptance pin: 3 tenant runs, ONE traversal of the source
+        assert (
+            tm.counter("engine.data_passes").value - passes_before == 1
+        )
+        assert (
+            tm.counter("service.runs_coalesced").value - coalesced_before
+            == 3
+        )
+        assert (
+            tm.counter("service.scan_passes_saved").value - saved_before
+            == 2
+        )
+        for want, got in zip(solo, results):
+            assert got.status == want.status
+            _assert_equal_values(
+                _values(AnalyzerContext(dict(got.metrics))),
+                _values(AnalyzerContext(dict(want.metrics))),
+            )
+            # every member keeps its OWN check evaluation
+            assert {c.description for c in got.check_results} == {
+                c.description for c in want.check_results
+            }
+
+    def test_members_persist_to_their_own_repositories(self):
+        repos = [InMemoryMetricsRepository() for _ in range(2)]
+        keys = [ResultKey.of(1000 + i) for i in range(2)]
+        svc = VerificationService(
+            workers=1, coalesce=True, coalesce_window_s=0.0
+        )
+        handles = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"t{i}",
+                    checks=_suite(i),
+                    dataset_key="shared/persist",
+                    dataset_factory=lambda: _table(),
+                    priority=Priority.BATCH,
+                    metrics_repository=repos[i],
+                    result_key=keys[i],
+                )
+            )
+            for i in range(2)
+        ]
+        svc.start()
+        try:
+            for h in handles:
+                h.result(timeout=300)
+        finally:
+            svc.stop(drain=False, timeout=30)
+        for i, (repo, key) in enumerate(zip(repos, keys)):
+            saved = repo.load_by_key(key)
+            assert saved is not None
+            solo = VerificationSuite.do_verification_run(
+                _table(), _suite(i)
+            )
+            saved_values = _values(saved.analyzer_context)
+            for ikey, value in _values(
+                AnalyzerContext(dict(solo.metrics))
+            ).items():
+                assert saved_values[ikey] == value, ikey
+
+    def test_superset_failure_degrades_to_independent(self, monkeypatch):
+        tm = get_telemetry()
+        fallbacks_before = tm.counter("service.coalesce_fallbacks").value
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("superset scan exploded")
+
+        monkeypatch.setattr(
+            VerificationSuite, "do_coalesced_verification_run", boom
+        )
+        svc = VerificationService(
+            workers=1, coalesce=True, coalesce_window_s=0.0
+        )
+        handles = self._submit_all_then_start(svc, 3)
+        try:
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            svc.stop(drain=False, timeout=30)
+        # every member still completed — independently
+        assert all(r.status == CheckStatus.SUCCESS for r in results)
+        assert (
+            tm.counter("service.coalesce_fallbacks").value
+            - fallbacks_before
+            == 1
+        )
+
+    def test_coalescing_off_by_default(self):
+        svc = VerificationService(workers=1)
+        assert svc.coalesce_policy is None
+        svc2 = VerificationService(
+            workers=1, coalesce=True, coalesce_window_s=2.5
+        )
+        assert svc2.coalesce_policy is not None
+        assert svc2.coalesce_policy.window_s == 2.5
+
+    def test_dataset_key_defaults_to_fingerprint(self):
+        """Satellite 6: the default dataset_key derives from the
+        dataset's content fingerprint, so two requests over the same
+        table coalesce (and share the cache) without the caller naming
+        the key — ``id()`` never matched across submissions."""
+        data = _table(seed=3)
+        r1 = RunRequest(tenant="a", checks=(), dataset=data)
+        r2 = RunRequest(tenant="b", checks=(), dataset=data)
+        assert r1.dataset_key == r2.dataset_key
+        assert r1.dataset_key == f"dataset-{data.fingerprint()}"
+
+
+# --------------------------------------------------------------------------
+# Satellite 2: coalescing under isolated execution
+# --------------------------------------------------------------------------
+
+
+def _iso_table():
+    return _table(n=2_000, seed=23)
+
+
+def _analyzer_suite(i):
+    base = [Completeness("a"), Mean("b")]
+    return base + ([Maximum("b")] if i % 2 == 0 else [Minimum("b")])
+
+
+def _child_crash(payload):
+    from deequ_tpu.testing.faults import hard_crash
+
+    hard_crash(payload.get("signum"))
+
+
+class TestIsolatedCoalescing:
+    def _service(self):
+        return VerificationService(
+            workers=1, isolated=True, coalesce=True, coalesce_window_s=0.0
+        )
+
+    def _submit(self, svc, n=3):
+        handles = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"t{i}",
+                    checks=(),
+                    required_analyzers=_analyzer_suite(i),
+                    dataset_key="shared/iso",
+                    dataset_factory=_iso_table,
+                    priority=Priority.BATCH,
+                )
+            )
+            for i in range(n)
+        ]
+        svc.start()
+        return handles
+
+    def test_one_child_per_superset_scan(self):
+        """The whole group crosses ONE process boundary: a single child
+        runs the superset scan and the member results come back in
+        order, equal to independent runs."""
+        tm = get_telemetry()
+        passes_before = tm.counter("engine.data_passes").value
+        coalesced_before = tm.counter("service.coalesced_scans").value
+        svc = self._service()
+        handles = self._submit(svc, n=3)
+        try:
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            svc.stop(drain=False, timeout=30)
+        assert (
+            tm.counter("service.coalesced_scans").value
+            - coalesced_before
+            == 1
+        )
+        # the child's fold-back summary carries its counters: ONE
+        # traversal total, in ONE child, for all three members
+        assert (
+            tm.counter("engine.data_passes").value - passes_before == 1
+        )
+        for i, result in enumerate(results):
+            solo = AnalysisRunner.do_analysis_run(
+                _iso_table(), _analyzer_suite(i), engine=AnalysisEngine()
+            )
+            _assert_equal_values(
+                _values(AnalyzerContext(dict(result.metrics))),
+                _values(solo),
+            )
+
+    def _crash_looped_service(self, monkeypatch):
+        svc = self._service()
+        monkeypatch.setattr(
+            svc, "_group_isolation_payload", lambda tickets: {"signum": None}
+        )
+        monkeypatch.setattr(
+            service_module, "_isolated_execute_coalesced", _child_crash
+        )
+        return svc
+
+    def test_crash_loop_floors_every_member(self, monkeypatch):
+        with config.configure(
+            degradation_policy="warn",
+            crash_max_relaunches=1,
+            crash_breaker_cooldown_s=0,
+        ):
+            svc = self._crash_looped_service(monkeypatch)
+            handles = self._submit(svc, n=3)
+            try:
+                results = [h.result(timeout=300) for h in handles]
+            finally:
+                svc.stop(drain=False, timeout=30)
+        for handle, result in zip(handles, results):
+            assert handle.status == RunState.DONE
+            assert result.status == CheckStatus.WARNING
+            assert result.metrics == {}
+            failure = result.degradation.failures[0]
+            assert failure.error_class == "CrashLoopError"
+            assert failure.attempts >= 1
+
+    def test_crash_loop_policy_fail_fails_every_member(self, monkeypatch):
+        with config.configure(
+            degradation_policy="fail",
+            crash_max_relaunches=1,
+            crash_breaker_cooldown_s=0,
+        ):
+            svc = self._crash_looped_service(monkeypatch)
+            handles = self._submit(svc, n=2)
+            try:
+                for handle in handles:
+                    assert handle.wait(timeout=300)
+                    assert handle.status == RunState.FAILED
+                    with pytest.raises(CrashLoopError):
+                        handle.result(timeout=0)
+            finally:
+                svc.stop(drain=False, timeout=30)

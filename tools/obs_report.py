@@ -367,6 +367,30 @@ def render_service(records: List[Dict[str, Any]]) -> str:
         )
         lines.append(f"    keys: {', '.join(keys)}")
 
+    # scan coalescing (docs/SERVICE.md "Scan coalescing"): how many
+    # runs shared a superset scan, the source passes that saved, and
+    # whether any superset fell back to independent execution
+    coalesced = [
+        e for e in events if e.get("event") == "runs_coalesced"
+    ]
+    if coalesced:
+        members = [int(e.get("members", 0)) for e in coalesced]
+        saved = sum(m - 1 for m in members)
+        fallbacks = sum(
+            1 for e in events if e.get("event") == "coalesce_fallback"
+        )
+        waits_max = max(
+            float(e.get("queue_wait_s_max", 0.0)) for e in coalesced
+        )
+        lines.append(
+            f"  coalescing: {sum(members)} run(s) over"
+            f" {len(coalesced)} superset scan(s)"
+            f" (passes saved={saved},"
+            f" max window wait={waits_max:.3f}s"
+            + (f", fallbacks={fallbacks}" if fallbacks else "")
+            + ")"
+        )
+
     # drains / rejections worth an operator's attention
     drains = [
         e for e in service_events
