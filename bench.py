@@ -154,6 +154,7 @@ CONFIG_REGISTRY = {
         lambda a: bench_streaming_ingest_parallel(a["rows"], a["cols"])
     ),
     "streaming_bundle_100m": lambda a: bench_streaming_bundle_100m(a["rows"]),
+    "rowlevel_egress": lambda a: bench_rowlevel_egress(a["rows"]),
 }
 
 
@@ -1618,6 +1619,106 @@ def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_rowlevel_egress(num_rows: int = 4_000_000):
+    """Row-level egress config (docs/EGRESS.md): the SAME mask/predicate
+    suite streamed twice — once with a RowLevelSink splitting every row
+    into clean/quarantine parquet, once metrics-only — so the price of
+    bytes OUT is measured differentially on identical data: wall
+    overhead, outbound bytes/row (raw -> encoded), and the pass
+    accounting (both arms must read the source exactly once; the split
+    rides the same fused scan the metrics do)."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.egress import RowLevelSink
+    from deequ_tpu.telemetry import get_telemetry
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    rng = np.random.default_rng(23)
+    amount = rng.gamma(2.0, 40.0, num_rows)
+    amount[rng.random(num_rows) < 0.01] *= -1.0
+    user = rng.integers(0, max(1, num_rows // 50), num_rows)
+    domain = np.where(rng.random(num_rows) < 0.05, "bad addr", "ex.com")
+    email = np.char.add(
+        np.char.add("u", user.astype("U12")), np.char.add("@", domain)
+    ).astype(object)
+    email[rng.random(num_rows) < 0.02] = None
+    data = Dataset.from_arrow(
+        pa.table(
+            {
+                "event_id": pa.array(np.arange(num_rows, dtype=np.int64)),
+                "amount": pa.array(amount),
+                "email": pa.array(email, type=pa.string()),
+            }
+        )
+    )
+    checks = [
+        Check(CheckLevel.ERROR, "hygiene")
+        .is_complete("email")
+        .has_pattern("email", r"@ex\.com$")
+        .satisfies("amount >= 0", "amount_non_negative")
+    ]
+    tm = get_telemetry()
+    workdirs = []
+
+    def run(egress_on: bool):
+        def once():
+            sink = None
+            if egress_on:
+                out_dir = tempfile.mkdtemp(prefix="deequ_tpu_bench_eg_")
+                workdirs.append(out_dir)
+                sink = RowLevelSink(out_dir)
+            return VerificationSuite.do_verification_run(
+                data, checks, row_level_sink=sink
+            )
+
+        with config.configure(device_cache_bytes=0):
+            once()  # warm the plan; priced runs below are steady-state
+            raw0 = tm.counter("engine.egress_bytes_raw").value
+            enc0 = tm.counter("engine.egress_bytes_encoded").value
+            passes0 = tm.counter("engine.data_passes").value
+            wall, _shipped, _mbps, result = _timed(once)
+        out = {
+            "wall_s": wall,
+            "rows_per_sec": num_rows / wall,
+            "data_passes": (
+                tm.counter("engine.data_passes").value - passes0
+            ),
+            "egress_raw_bytes_per_row": (
+                tm.counter("engine.egress_bytes_raw").value - raw0
+            ) / num_rows,
+            "egress_encoded_bytes_per_row": (
+                tm.counter("engine.egress_bytes_encoded").value - enc0
+            ) / num_rows,
+        }
+        if egress_on:
+            report = result.row_level_egress
+            out["egress_status"] = report.status
+            out["rows_clean"] = report.rows_clean
+            out["rows_quarantined"] = report.rows_quarantined
+        return out
+
+    try:
+        on = run(True)
+        off = run(False)
+        return {
+            "rows": num_rows,
+            "egress_on": on,
+            "egress_off": off,
+            "wall_overhead": (
+                on["wall_s"] / off["wall_s"] if off["wall_s"] > 0 else 0.0
+            ),
+        }
+    finally:
+        for d in workdirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -1851,6 +1952,7 @@ def main(argv=None):
                 400,
             ),
             ("streaming_bundle_100m", {"rows": 100_000_000}, True, 330),
+            ("rowlevel_egress", {"rows": 4_000_000}, True, 200),
         ]
     )
 

@@ -318,7 +318,10 @@ class PatternMatch(ScanShareableAnalyzer):
         ) -> S.NumMatchesAndCount:
             lut = consts["lut"]
             rows = _row_mask(batch, where_fn)
-            codes = batch[f"{col}::codes"]
+            # codes arrive wire-narrowed (int16 for small dicts); the
+            # LUT gather's clip bound must not overflow when a >32k
+            # dictionary pads past the int16 range
+            codes = batch[f"{col}::codes"].astype(jnp.int32)
             valid = batch[f"{col}::mask"] & rows
             hits = lut[jnp.clip(codes, 0, lut.shape[0] - 1)] & valid
             return S.NumMatchesAndCount(

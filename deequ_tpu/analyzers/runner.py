@@ -181,6 +181,7 @@ class AnalysisRunner:
         save_or_append_results_with_key=None,
         deadline=None,
         cancel=None,
+        row_sink=None,
     ) -> AnalyzerContext:
         """Run the analysis. ``deadline`` (seconds, or a full
         ``RunBudget``) and ``cancel`` (a ``CancelToken``) bound the run
@@ -268,6 +269,7 @@ class AnalysisRunner:
                 reuse_existing_results_for_key=reuse_existing_results_for_key,
                 fail_if_results_missing=fail_if_results_missing,
                 save_or_append_results_with_key=save_or_append_results_with_key,
+                row_sink=row_sink,
             )
         finally:
             if admitted:
@@ -311,6 +313,7 @@ class AnalysisRunner:
         reuse_existing_results_for_key=None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key=None,
+        row_sink=None,
     ) -> AnalyzerContext:
         # fresh degradation record for THIS run; every scan the run
         # issues (shared pass + deferred fallbacks) merges into it
@@ -372,7 +375,7 @@ class AnalysisRunner:
             # suite costs a single pass over the data (SURVEY.md §2.4);
             # device-sort/Arrow spill plans run right after, reusing the
             # chunks the shared scan just cached
-            if scan_shareable or grouping:
+            if scan_shareable or grouping or row_sink is not None:
                 with timed_pass(
                     metadata, "scan", rows,
                     len(scan_shareable) + len(grouping),
@@ -381,6 +384,7 @@ class AnalysisRunner:
                         _run_fused_pass(
                             data, scan_shareable, grouping, engine,
                             aggregate_with, save_states_with, metadata,
+                            row_sink=row_sink,
                         )
                     )
 
@@ -534,6 +538,10 @@ class FusedPassPlan:
     collectors: List[Any]
     deferred: Dict[Any, Any]
     scan_pairs: List[Tuple[Any, Any]]
+    # row-level egress (deequ_tpu/egress): a RowSinkPlan whose op rides
+    # LAST in scan_pairs — its per-batch bit planes host_fold straight
+    # into the quarantine writer; None for ordinary runs
+    row_sink: Any = None
 
     @property
     def empty(self) -> bool:
@@ -546,6 +554,7 @@ def _plan_fused_pass(
     grouping: List[GroupingAnalyzer],
     engine: AnalysisEngine,
     metadata=None,
+    row_sink=None,
 ) -> FusedPassPlan:
     """Phase 1 of the fused pass: vectorize the scan-shareable
     analyzers, plan the grouping frequency passes, and assemble the
@@ -592,6 +601,9 @@ def _plan_fused_pass(
             for spec in collectors
         ]
     )
+    if row_sink is not None:
+        # the sink op rides LAST so every metric slice keeps its index
+        scan_pairs = scan_pairs + [row_sink.scan_pair]
     return FusedPassPlan(
         metrics=metrics,
         units=units,
@@ -600,6 +612,7 @@ def _plan_fused_pass(
         collectors=collectors,
         deferred=deferred,
         scan_pairs=scan_pairs,
+        row_sink=row_sink,
     )
 
 
@@ -611,6 +624,7 @@ def _run_fused_pass(
     aggregate_with,
     save_states_with,
     metadata=None,
+    row_sink=None,
 ) -> Dict[Analyzer, Metric]:
     """Plan + run THE fused scan: scan-shareable analyzers (vectorized
     into stacked group ops, engine/vectorize.py), dense grouping
@@ -626,7 +640,9 @@ def _run_fused_pass(
     so persistence/merge semantics are identical to the single path.
     Composes ``_plan_fused_pass`` + ``_execute_fused_pass`` — the
     runner-layer compile/execute split."""
-    pass_plan = _plan_fused_pass(data, analyzers, grouping, engine, metadata)
+    pass_plan = _plan_fused_pass(
+        data, analyzers, grouping, engine, metadata, row_sink=row_sink
+    )
     if pass_plan.empty:
         return pass_plan.metrics
     return _execute_fused_pass(
@@ -657,16 +673,28 @@ def _execute_fused_pass(
     collectors = pass_plan.collectors
     deferred = pass_plan.deferred
     scan_pairs = pass_plan.scan_pairs
+    row_sink = pass_plan.row_sink
 
     states = None
     if scan_pairs:
         try:
-            states = engine.run_scan(data, scan_pairs)
+            if row_sink is None:
+                states = engine.run_scan(data, scan_pairs)
+            else:
+                # split phases so the sink learns the scan's quarantine
+                # geometry (chunk rows resident / batch rows streaming)
+                # BEFORE the first fold hits its writer
+                scan_plan = engine.prepare_scan(data, scan_pairs)
+                row_sink.bind_scan_geometry(scan_plan, data, engine)
+                states = engine.execute_plan(scan_plan, data)
+                row_sink.note_scan_complete(engine)
             if metadata is not None and engine.phase_times is not None:
                 metadata.events.append(
                     {"event": "scan_phases", **engine.phase_times}
                 )
         except Exception as exc:  # noqa: BLE001
+            if row_sink is not None:
+                row_sink.mark_scan_failed()
             wrapped = wrap_if_necessary(exc)
             for unit in units:
                 for analyzer in unit.members:
@@ -727,7 +755,12 @@ def _execute_fused_pass(
         frequencies.update(
             finalize_collector_states(
                 collectors,
-                states[len(units) + len(dense):],
+                # bounded slice: the row-sink op (when present) rides
+                # BEHIND the collectors and must not leak into them
+                states[
+                    len(units) + len(dense):
+                    len(units) + len(dense) + len(collectors)
+                ],
                 isolate=True,
                 cancel=engine.cancel,
                 oom_probe=oom_probe_of(data),

@@ -1,0 +1,35 @@
+"""Streaming row-level egress: on-scan bad-row extraction to a
+partitioned clean/quarantine parquet split. See docs/EGRESS.md.
+
+- :class:`RowLevelSink` — the user-facing request (pass to
+  ``VerificationRunBuilder.with_row_level_sink`` or ``row_level_sink=``
+  on ``do_verification_run`` / ``service.RunRequest``);
+- :class:`EgressReport` — what one run's egress produced
+  (``sink.report`` / ``result.row_level_egress``);
+- :data:`BATCH_QUARANTINED` — the ``__failed_constraints__`` marker for
+  rows whose whole batch was quarantined by the resilience layer;
+- ``plan_row_sink`` / ``finalize_row_sink`` — the run integration
+  surface (used by ``verification/suite.py``).
+"""
+
+from deequ_tpu.egress.plan import (
+    RowSinkPlan,
+    finalize_row_sink,
+    plan_row_sink,
+)
+from deequ_tpu.egress.writer import (
+    BATCH_QUARANTINED,
+    EgressReport,
+    QuarantineWriter,
+    RowLevelSink,
+)
+
+__all__ = [
+    "BATCH_QUARANTINED",
+    "EgressReport",
+    "QuarantineWriter",
+    "RowLevelSink",
+    "RowSinkPlan",
+    "finalize_row_sink",
+    "plan_row_sink",
+]

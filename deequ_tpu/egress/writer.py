@@ -1,0 +1,647 @@
+"""Host-side quarantine/clean parquet writer for row-level egress.
+
+This module is HOST-ONLY by design (enforced by the ``wire-discipline``
+staticcheck rule): the device half of egress lives in
+``deequ_tpu/egress/plan.py`` — per-row constraint masks evaluated
+inside the fused scan and bit-packed per batch. What arrives here is
+the already-fetched packed epilogue output (numpy uint8 bit planes +
+a valid-row count), and everything this module does is Arrow/parquet
+plumbing:
+
+- a **sequential span reader** pulls row content for each consumed span
+  from the dataset's ``record_batches`` iterator — zero-copy slices for
+  in-memory tables, a host-side sequential re-read for parquet sources
+  (the device wire is never touched, ``engine.data_passes`` counts only
+  metric scans);
+- per span (one fold of the scan — a batch or an OOM sub-slice), rows
+  split into the **clean** and **quarantine** outputs, each written as
+  its own parquet row group immediately — the writer's host footprint
+  is bounded by one span, never the table (flush-per-batch, also a
+  staticcheck rule);
+- **quarantined-batch degradation** (engine/resilience.py) folds into
+  the SAME artifact: a batch the scan skipped lands whole in the
+  quarantine output with its ``BatchFailure`` provenance
+  (``__error_class__``/``__error_message__``/``__retry_attempts__``)
+  and NULL outcome columns (the scan never evaluated them);
+- the **wire-codec discipline** applies symmetrically on the way out:
+  provenance integers (``__row_index__``, ``__batch_seq__``) are
+  narrowed via ``engine.wire.narrowest_int_dtype`` — decided ONCE at
+  geometry-bind time from the row count, never per batch.
+
+Filtered-row semantics mirror ``verification/rowlevel.py`` exactly
+(the differential oracle): under ``"true"`` a where-excluded row
+passes; under ``"null"`` its outcome column is SQL NULL and only
+``~pass & ~excluded`` quarantines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from deequ_tpu.engine.wire import narrowest_int_dtype
+from deequ_tpu.telemetry import get_telemetry
+
+#: ``__failed_constraints__`` marker for rows the scan never evaluated
+#: because their whole batch was quarantined by the resilience layer
+BATCH_QUARANTINED = "__batch_quarantined__"
+
+_PROV_ROW = "__row_index__"
+_PROV_SEQ = "__batch_seq__"
+_PROV_FAILED = "__failed_constraints__"
+_PROV_ERR_CLASS = "__error_class__"
+_PROV_ERR_MSG = "__error_message__"
+_PROV_ATTEMPTS = "__retry_attempts__"
+_PROV_TENANT = "__tenant__"
+_PROV_RUN = "__run_id__"
+
+
+@dataclass
+class RowLevelSink:
+    """User-facing egress request: stream row-level pass/fail outcomes
+    to a partitioned clean/quarantine parquet split under ``out_dir``.
+
+    Pass one to ``VerificationRunBuilder.with_row_level_sink`` (or
+    ``row_level_sink=`` on ``do_verification_run`` /
+    ``service.RunRequest``); after the run, ``sink.report`` (also
+    ``result.row_level_egress``) describes what was written. See
+    docs/EGRESS.md."""
+
+    out_dir: str
+    #: "true" (where-excluded rows pass, the reference default) or
+    #: "null" (nullable outcome columns, only ~pass & ~excluded fails)
+    filtered_row_outcome: str = "true"
+    #: row columns to carry into the split (default: every column)
+    columns: Optional[Sequence[str]] = None
+    tenant: str = ""
+    run_id: str = ""
+    #: set by the run: the EgressReport for the last finalize
+    report: Optional["EgressReport"] = None
+
+    def __post_init__(self):
+        if self.filtered_row_outcome not in ("true", "null"):
+            raise ValueError(
+                "filtered_row_outcome must be 'true' or 'null', got "
+                f"{self.filtered_row_outcome!r}"
+            )
+
+
+@dataclass
+class EgressReport:
+    """What one run's egress produced (also serialized into the
+    manifest)."""
+
+    status: str  # complete | interrupted | aborted | no_row_level_constraints
+    rows_total: int = 0
+    rows_clean: int = 0
+    rows_quarantined: int = 0
+    bytes_raw: int = 0
+    bytes_encoded: int = 0
+    #: constraint name -> "scan" (rode the fused scan) or "deferred"
+    #: (finalize phase: uniqueness / untraceable assertions)
+    constraints: Dict[str, str] = field(default_factory=dict)
+    #: constraint name -> reason it has no outcome column
+    unsupported: Dict[str, str] = field(default_factory=dict)
+    clean_dir: str = ""
+    quarantine_dir: str = ""
+    manifest_path: str = ""
+
+    @property
+    def rows_written(self) -> int:
+        return self.rows_clean + self.rows_quarantined
+
+
+class _SpanReader:
+    """Sequential row-content reader: ``take(n)`` returns the next n
+    source rows as an Arrow table. Backed by the dataset's
+    ``record_batches`` iterator, so the buffered remainder is bounded
+    by one read batch plus one span — never the table."""
+
+    def __init__(self, data, columns: Sequence[str], batch_rows: int = 1 << 16):
+        self._iter = iter(data.record_batches(list(columns), batch_rows))
+        self._parts: List[pa.Table] = []
+        self._buffered = 0
+        self.schema: Optional[pa.Schema] = None
+
+    def take(self, n: int) -> pa.Table:
+        while self._buffered < n:
+            nxt = next(self._iter, None)
+            if nxt is None:
+                break
+            part = pa.Table.from_batches([nxt])
+            if self.schema is None:
+                self.schema = part.schema
+            self._parts.append(part)
+            self._buffered += part.num_rows
+        if self._buffered < n:
+            raise RuntimeError(
+                f"egress span reader exhausted: need {n} more rows, "
+                f"source has {self._buffered} — span accounting is "
+                "misaligned with the scan"
+            )
+        tbl = (
+            pa.concat_tables(self._parts)
+            if len(self._parts) > 1
+            else self._parts[0]
+        )
+        out = tbl.slice(0, n)
+        rest = tbl.slice(n)
+        self._parts = [rest] if rest.num_rows else []
+        self._buffered = rest.num_rows
+        return out.combine_chunks()
+
+
+@dataclass
+class _FailureSpan:
+    """A quarantined-batch span in SOURCE row coordinates (failures
+    always cover the TAIL of their scan unit — the rows the partial
+    sub-dispatch never folded)."""
+
+    start: int
+    length: int
+    error_class: str
+    message: str
+    attempts: int
+
+
+def _combine(
+    passes: np.ndarray, excl: Optional[np.ndarray], mode: str
+) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
+    """(outcome values, null mask or None, per-row fail) for one
+    constraint — the exact ``rowlevel.row_level_results`` semantics."""
+    if excl is None:
+        return passes, None, ~passes
+    if mode == "true":
+        outcome = passes | excl
+        return outcome, None, ~outcome
+    # "null": excluded rows are SQL NULL and never quarantine
+    return passes, excl, ~passes & ~excl
+
+
+class QuarantineWriter:
+    """Streams one run's row-level outcomes to a clean/quarantine
+    parquet split. Two operating modes:
+
+    - **direct** (every outcome column rides the scan): each fold's
+      span is written as soon as it is consumed — rows fetched, split,
+      and flushed per batch;
+    - **spool** (deferred constraints present — uniqueness or an
+      untraceable assertion): the scan phase spools only the packed
+      bit planes to disk (flushed per batch, ~planes/8 bytes per row),
+      and ``finalize`` replays them merged with the finalize-phase
+      outcomes. The deferred families need a second look at the data
+      by nature (uniqueness is global), so the run honestly reports
+      ``engine.data_passes == 2``.
+    """
+
+    def __init__(
+        self,
+        sink: RowLevelSink,
+        data,
+        scan_names: Sequence[str],
+        excl_of: Sequence[Optional[int]],
+        deferred_names: Sequence[str],
+        plane_shape: Tuple[int, int],
+        row_columns: Sequence[str],
+    ):
+        self.sink = sink
+        self._data = data
+        self.scan_names = list(scan_names)
+        self.excl_of = list(excl_of)
+        self.deferred_names = list(deferred_names)
+        self._plane_shape = tuple(plane_shape)
+        self._row_columns = list(row_columns)
+        self.num_rows = int(data.num_rows)
+        self.cursor = 0
+        self.rows_clean = 0
+        self.rows_quarantined = 0
+        self.bytes_raw = 0
+        self.bytes_encoded = 0
+        self._reader: Optional[_SpanReader] = None
+        self._writers: Dict[str, pq.ParquetWriter] = {}
+        self._paths: Dict[str, str] = {}
+        self._schemas: Dict[str, pa.Schema] = {}
+        self._row_schema: Optional[pa.Schema] = None
+        # scan-unit geometry (set by bind_geometry once the engine has
+        # planned the scan): unit_rows is the quarantine granularity —
+        # a CHUNK on the resident path, a batch on the streaming path
+        self._unit_rows: Optional[int] = None
+        self._batch_size: Optional[int] = None
+        self._idx_dtype = narrowest_int_dtype(0, max(self.num_rows - 1, 0))
+        self._seq_dtype = np.dtype(np.int64)
+        self._probe = None  # live ScanDegradation supplier (direct mode)
+        self._pending: List[_FailureSpan] = []
+        self._seen_failure_idx: set = set()
+        self._last_record: Any = None
+        self.spool_mode = bool(self.deferred_names)
+        self._spool = None
+        self._spool_path = os.path.join(sink.out_dir, "_scan_bits.spool")
+        os.makedirs(sink.out_dir, exist_ok=True)
+        if self.spool_mode:
+            self._spool = open(self._spool_path, "wb")
+
+    # -- wiring ---------------------------------------------------------
+
+    def bind_geometry(self, unit_rows: int, batch_size: int) -> None:
+        """Called once the engine has planned the scan: the unit the
+        resilience layer quarantines at (chunk rows on the resident
+        path, batch rows streaming) and the batch size for
+        ``__batch_seq__``. Narrowing for ``__batch_seq__`` is decided
+        HERE, once per run — never per batch (wire discipline)."""
+        self._unit_rows = int(unit_rows)
+        self._batch_size = int(batch_size)
+        n_units = max(
+            1, -(-max(self.num_rows, 1) // max(self._batch_size, 1))
+        )
+        self._seq_dtype = narrowest_int_dtype(0, n_units - 1)
+
+    def set_degradation_probe(self, probe) -> None:
+        """Direct mode: a callable returning the ACTIVE scan's live
+        ``ScanDegradation`` record, consulted before each span so
+        quarantined units interleave into the output in source order."""
+        self._probe = probe
+
+    # -- scan-phase consumption (called from the op's host_fold) --------
+
+    def consume(self, bits: np.ndarray, valid: int) -> None:
+        """One fold of the scan: ``bits`` is the packed (planes, B/8)
+        uint8 output, ``valid`` the number of real rows it covers (the
+        batch's True-prefix). Either written through immediately
+        (direct) or spooled (deferred constraints present); both paths
+        flush per call — the host never accumulates row content."""
+        bits = np.ascontiguousarray(bits, dtype=np.uint8)
+        valid = int(valid)
+        if bits.shape != self._plane_shape:
+            raise RuntimeError(
+                f"egress fold shape {bits.shape} != planned "
+                f"{self._plane_shape}"
+            )
+        if self.spool_mode:
+            self._spool.write(struct.pack("<q", valid))
+            self._spool.write(bits.tobytes())
+            self._spool.flush()
+            return
+        if self._probe is not None:
+            self._refresh_failures(self._probe())
+        self._emit(bits, valid, deferred=None)
+
+    # -- failure interleaving -------------------------------------------
+
+    def _refresh_failures(self, record) -> None:
+        if record is None:
+            return
+        self._last_record = record
+        unit = self._unit_rows
+        if unit is None:
+            raise RuntimeError(
+                "egress writer has no scan geometry — bind_geometry "
+                "was never called"
+            )
+        for f in getattr(record, "failures", ()):
+            idx = int(f.batch_index)
+            if idx in self._seen_failure_idx:
+                continue
+            self._seen_failure_idx.add(idx)
+            unit_rows = max(0, min(self.num_rows - idx * unit, unit))
+            length = min(int(f.rows), unit_rows)
+            # partial quarantines cover the TAIL of the unit — the
+            # prefix was folded by the sub-dispatch before it gave up
+            start = idx * unit + (unit_rows - length)
+            self._pending.append(
+                _FailureSpan(
+                    start=start,
+                    length=length,
+                    error_class=str(f.error_class),
+                    message=str(f.message),
+                    attempts=int(f.attempts),
+                )
+            )
+        self._pending.sort(key=lambda s: s.start)
+
+    def _drain_failures(self) -> None:
+        while self._pending and self._pending[0].start <= self.cursor:
+            span = self._pending.pop(0)
+            if span.start < self.cursor:
+                raise RuntimeError(
+                    f"egress alignment: quarantined span at row "
+                    f"{span.start} overlaps rows already written "
+                    f"(cursor {self.cursor})"
+                )
+            self._emit_failure(span)
+
+    # -- span emission ---------------------------------------------------
+
+    def _ensure_reader(self) -> _SpanReader:
+        if self._reader is None:
+            self._reader = _SpanReader(self._data, self._row_columns)
+        return self._reader
+
+    def _emit(
+        self,
+        bits: np.ndarray,
+        valid: int,
+        deferred: Optional[Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]],
+    ) -> None:
+        self._drain_failures()
+        if valid <= 0:
+            return
+        start = self.cursor
+        planes = np.unpackbits(bits, axis=1, bitorder="little")[
+            :, :valid
+        ].astype(bool)
+        n_scan = len(self.scan_names)
+        outcome_cols: List[Tuple[str, np.ndarray, Optional[np.ndarray]]] = []
+        fails: List[np.ndarray] = []
+        for i, name in enumerate(self.scan_names):
+            e = self.excl_of[i]
+            excl = planes[n_scan + e] if e is not None else None
+            outcome, null_mask, fail = _combine(
+                planes[i], excl, self.sink.filtered_row_outcome
+            )
+            outcome_cols.append((name, outcome, null_mask))
+            fails.append(fail)
+        for name in self.deferred_names:
+            full = (deferred or {}).get(name)
+            if full is None:
+                continue  # oracle degraded this constraint at finalize
+            full_out, full_excl = full
+            p = np.asarray(full_out[start : start + valid], dtype=bool)
+            excl = (
+                np.asarray(full_excl[start : start + valid], dtype=bool)
+                if full_excl is not None
+                else None
+            )
+            outcome, null_mask, fail = _combine(
+                p, excl, self.sink.filtered_row_outcome
+            )
+            outcome_cols.append((name, outcome, null_mask))
+            fails.append(fail)
+        fail_any = (
+            np.logical_or.reduce(fails)
+            if fails
+            else np.zeros(valid, dtype=bool)
+        )
+        rows = self._ensure_reader().take(valid)
+        row_idx = np.arange(start, start + valid)
+        clean_sel = ~fail_any
+        self._write_split("clean", rows, outcome_cols, clean_sel, row_idx)
+        self._write_split(
+            "quarantine",
+            rows,
+            outcome_cols,
+            fail_any,
+            row_idx,
+            failed_labels=self._failed_labels(fails, fail_any, outcome_cols),
+        )
+        n_clean = int(clean_sel.sum())
+        self.rows_clean += n_clean
+        self.rows_quarantined += valid - n_clean
+        self.cursor += valid
+
+    def _failed_labels(
+        self,
+        fails: List[np.ndarray],
+        fail_any: np.ndarray,
+        outcome_cols: List[Tuple[str, np.ndarray, Optional[np.ndarray]]],
+    ) -> List[str]:
+        """One ';'-joined failing-constraint set per quarantined row,
+        built per UNIQUE failure pattern (never per-row Python over
+        every row)."""
+        nq = int(fail_any.sum())
+        if nq == 0 or not fails:
+            return []
+        names = [name for name, _o, _m in outcome_cols]
+        sub = np.stack(fails, axis=0)[:, fail_any]
+        uniq, inv = np.unique(sub, axis=1, return_inverse=True)
+        labels = [
+            ";".join(names[i] for i in np.nonzero(uniq[:, k])[0])
+            for k in range(uniq.shape[1])
+        ]
+        return [labels[j] for j in np.asarray(inv).ravel()]
+
+    def _emit_failure(self, span: _FailureSpan) -> None:
+        """A quarantined scan unit lands WHOLE in the quarantine output
+        with its BatchFailure provenance; outcome columns are NULL (the
+        scan never evaluated them for these rows)."""
+        rows = self._ensure_reader().take(span.length)
+        row_idx = np.arange(span.start, span.start + span.length)
+        null_outcomes = [
+            (name, None, None)
+            for name in self.scan_names + self.deferred_names
+        ]
+        self._write_split(
+            "quarantine",
+            rows,
+            null_outcomes,
+            np.ones(span.length, dtype=bool),
+            row_idx,
+            failed_labels=[BATCH_QUARANTINED] * span.length,
+            error=(span.error_class, span.message, span.attempts),
+        )
+        self.rows_quarantined += span.length
+        self.cursor += span.length
+
+    def _write_split(
+        self,
+        which: str,
+        rows: pa.Table,
+        outcome_cols: Sequence[Tuple[str, Optional[np.ndarray], Optional[np.ndarray]]],
+        sel: np.ndarray,
+        row_idx: np.ndarray,
+        failed_labels: Optional[List[str]] = None,
+        error: Optional[Tuple[str, str, int]] = None,
+    ) -> None:
+        n = int(sel.sum())
+        if n == 0:
+            return
+        if self._row_schema is None:
+            self._row_schema = rows.schema
+        sel_pa = pa.array(sel)
+        arrays = list(rows.filter(sel_pa).columns)
+        names = list(rows.schema.names)
+        for name, outcome, null_mask in outcome_cols:
+            if outcome is None:  # batch-quarantined: never evaluated
+                arrays.append(pa.nulls(n, pa.bool_()))
+            elif null_mask is None:
+                arrays.append(pa.array(outcome[sel]))
+            else:
+                arrays.append(pa.array(outcome[sel], mask=null_mask[sel]))
+            names.append(name)
+        idx = row_idx[sel]
+        arrays.append(pa.array(idx.astype(self._idx_dtype)))
+        names.append(_PROV_ROW)
+        seq = idx // max(int(self._batch_size or 1), 1)
+        arrays.append(pa.array(seq.astype(self._seq_dtype)))
+        names.append(_PROV_SEQ)
+        # wire diet, egress direction: provenance ints narrowed once
+        # per run; raw prices the same columns at canonical int64
+        raw_extra = (16 - self._idx_dtype.itemsize - self._seq_dtype.itemsize) * n
+        if which == "quarantine":
+            arrays.append(
+                pa.array(failed_labels or [""] * n, pa.string())
+            )
+            names.append(_PROV_FAILED)
+            err_class, err_msg, attempts = error or (None, None, 0)
+            arrays.append(pa.array([err_class] * n, pa.string()))
+            names.append(_PROV_ERR_CLASS)
+            arrays.append(pa.array([err_msg] * n, pa.string()))
+            names.append(_PROV_ERR_MSG)
+            arrays.append(
+                pa.array(np.full(n, int(attempts), dtype=np.int32))
+            )
+            names.append(_PROV_ATTEMPTS)
+            arrays.append(
+                pa.array([self.sink.tenant] * n, pa.string())
+            )
+            names.append(_PROV_TENANT)
+            arrays.append(pa.array([self.sink.run_id] * n, pa.string()))
+            names.append(_PROV_RUN)
+        table = pa.Table.from_arrays(arrays, names=names)
+        writer = self._ensure_writer(which, table.schema)
+        writer.write_table(table)  # one row group per span: the flush
+        nbytes = table.nbytes
+        self.bytes_encoded += nbytes
+        self.bytes_raw += nbytes + raw_extra
+        tm = get_telemetry()
+        tm.counter("engine.egress_bytes_encoded").inc(nbytes)
+        tm.counter("engine.egress_bytes_raw").inc(nbytes + raw_extra)
+
+    def _ensure_writer(self, which: str, schema: pa.Schema) -> pq.ParquetWriter:
+        writer = self._writers.get(which)
+        if writer is None:
+            split_dir = os.path.join(self.sink.out_dir, which)
+            os.makedirs(split_dir, exist_ok=True)
+            path = os.path.join(split_dir, "part-00000.parquet")
+            writer = pq.ParquetWriter(path, schema)
+            self._writers[which] = writer
+            self._paths[which] = path
+            self._schemas[which] = schema
+        return writer
+
+    # -- finalize --------------------------------------------------------
+
+    def replay_spool(
+        self,
+        deferred: Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]],
+        record,
+    ) -> None:
+        """Spool mode phase 2: merge the scanned bit planes with the
+        finalize-phase (deferred) outcomes and write the split, span by
+        span — bounded by one span, exactly like the direct path."""
+        self._spool.close()
+        self._spool = None
+        self._refresh_failures(record)
+        n_planes, b8 = self._plane_shape
+        rec_bytes = n_planes * b8
+        with open(self._spool_path, "rb") as fh:
+            while True:
+                head = fh.read(8)
+                if len(head) < 8:
+                    break
+                (valid,) = struct.unpack("<q", head)
+                payload = fh.read(rec_bytes)
+                bits = np.frombuffer(payload, dtype=np.uint8).reshape(
+                    n_planes, b8
+                )
+                self._emit(bits, int(valid), deferred=deferred)
+
+    def finish(self, record, interrupted: bool) -> Tuple[int, int]:
+        """Drain trailing quarantined units (a failure after the last
+        fold), close the parquet writers (writing empty files for a
+        split that never materialized, so consumers can always read
+        both), and return (rows_clean, rows_quarantined)."""
+        self._refresh_failures(record)
+        self._drain_failures()
+        if not interrupted and self.cursor != self.num_rows:
+            # not an exception: an interrupt mid-scan legitimately
+            # leaves a tail unwritten, and we only know "interrupted"
+            # when the engine says so — anything else is a real
+            # misalignment worth surfacing loudly
+            raise RuntimeError(
+                f"egress wrote {self.cursor} of {self.num_rows} source "
+                "rows without an interruption — span accounting bug"
+            )
+        for which in ("clean", "quarantine"):
+            if which not in self._writers and self._row_schema is not None:
+                self._ensure_writer(
+                    which, self._empty_schema_for(which)
+                )
+        for writer in self._writers.values():
+            writer.close()
+        self._writers.clear()
+        if self._spool is not None:
+            self._spool.close()
+            self._spool = None
+        if os.path.exists(self._spool_path):
+            os.remove(self._spool_path)
+        tm = get_telemetry()
+        tm.counter("engine.rows_clean").inc(self.rows_clean)
+        tm.counter("engine.rows_quarantined").inc(self.rows_quarantined)
+        return self.rows_clean, self.rows_quarantined
+
+    def abort(self) -> None:
+        """Scan failed outright: close everything without the
+        alignment check; whatever was written stays on disk for
+        inspection, the report says 'aborted'."""
+        for writer in self._writers.values():
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._writers.clear()
+        if self._spool is not None:
+            try:
+                self._spool.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._spool = None
+        if os.path.exists(self._spool_path):
+            os.remove(self._spool_path)
+
+    def _empty_schema_for(self, which: str) -> pa.Schema:
+        fields = list(self._row_schema)
+        for name in self.scan_names + self.deferred_names:
+            fields.append(pa.field(name, pa.bool_()))
+        fields.append(pa.field(_PROV_ROW, pa.from_numpy_dtype(self._idx_dtype)))
+        fields.append(pa.field(_PROV_SEQ, pa.from_numpy_dtype(self._seq_dtype)))
+        if which == "quarantine":
+            fields.extend(
+                [
+                    pa.field(_PROV_FAILED, pa.string()),
+                    pa.field(_PROV_ERR_CLASS, pa.string()),
+                    pa.field(_PROV_ERR_MSG, pa.string()),
+                    pa.field(_PROV_ATTEMPTS, pa.int32()),
+                    pa.field(_PROV_TENANT, pa.string()),
+                    pa.field(_PROV_RUN, pa.string()),
+                ]
+            )
+        return pa.schema(fields)
+
+    def write_manifest(self, report: EgressReport, extra: Dict[str, Any]) -> str:
+        path = os.path.join(self.sink.out_dir, "manifest.json")
+        payload = {
+            "status": report.status,
+            "tenant": self.sink.tenant,
+            "run_id": self.sink.run_id,
+            "filtered_row_outcome": self.sink.filtered_row_outcome,
+            "rows_total": report.rows_total,
+            "rows_clean": report.rows_clean,
+            "rows_quarantined": report.rows_quarantined,
+            "bytes_raw": report.bytes_raw,
+            "bytes_encoded": report.bytes_encoded,
+            "constraints": report.constraints,
+            "unsupported": report.unsupported,
+            "clean": self._paths.get("clean", ""),
+            "quarantine": self._paths.get("quarantine", ""),
+            **extra,
+        }
+        with open(path, "w") as fh:
+            json.dump(payload, fh, indent=2, default=str)
+        return path

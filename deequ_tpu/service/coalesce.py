@@ -54,7 +54,12 @@ class CoalescePolicy:
     def may_coalesce(self, ticket: RunTicket) -> bool:
         """INTERACTIVE runs neither host nor join a group: a superset
         scan's wall time is the max over members, and an interactive
-        run must never inherit a batch suite's runtime."""
+        run must never inherit a batch suite's runtime. Row-level-sink
+        runs never coalesce either — the egress artifact is per-run
+        (one writer, one manifest), while a superset scan serves many
+        tenants from one traversal."""
+        if getattr(ticket.payload, "row_level_sink", None) is not None:
+            return False
         return ticket.handle.priority > Priority.INTERACTIVE
 
     def compatible(

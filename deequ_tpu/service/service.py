@@ -83,6 +83,11 @@ class RunRequest:
     deadline_s: Optional[float] = None
     metrics_repository: Any = None
     result_key: Any = None
+    #: egress.RowLevelSink — stream this run's row-level outcomes to a
+    #: clean/quarantine parquet split (docs/EGRESS.md). Sink runs never
+    #: coalesce (the artifact is per-run) and always execute in-process
+    #: (the writer's file handles cannot cross a spawn boundary).
+    row_level_sink: Any = None
 
     def __post_init__(self):
         if self.dataset is not None and self.dataset_factory is None:
@@ -626,6 +631,7 @@ class VerificationService:
                 save_or_append_results_with_key=request.result_key,
                 deadline=ticket.budget,
                 cancel=ticket.handle.cancel_token,
+                row_level_sink=request.row_level_sink,
             )
         finally:
             self.datasets.release(request.dataset_key)
@@ -644,6 +650,11 @@ class VerificationService:
         holds closures that cannot cross a process boundary (the caller
         then falls back to in-process execution, loudly)."""
         request: RunRequest = ticket.payload
+        if request.row_level_sink is not None:
+            # the sink's writer owns local file handles and the report
+            # must land on the SUBMITTING process's sink object — run
+            # in-process (the fallback path logs the decision)
+            return None
         payload = {
             "run_id": ticket.handle.run_id,
             "dataset_key": request.dataset_key,

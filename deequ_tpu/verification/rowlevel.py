@@ -57,25 +57,66 @@ from deequ_tpu.constraints.constraint import (
 from deequ_tpu.sql.predicate import compile_predicate
 
 
-def _full_batch(data: Dataset, requests) -> Dict[str, np.ndarray]:
-    batch = {r.key: data.materialize(r) for r in requests}
+class _OracleCache:
+    """Per-call materialization cache: one export touches only the
+    columns its row-level constraints actually request, each at most
+    ONCE — the row mask is built a single time, a ``where`` predicate
+    shared by several constraints compiles and evaluates once, and a
+    column two constraints both read is pulled from the source once
+    (parquet sources re-read on every ``materialize``). Scoped to one
+    ``row_level_results`` / egress-finalize call so nothing outlives
+    the export."""
+
+    def __init__(self, data: Dataset):
+        self._data = data
+        self._arrays: Dict[str, np.ndarray] = {}
+        self._row_mask: Optional[np.ndarray] = None
+        self._where: Dict[str, Optional[np.ndarray]] = {}
+
+    def materialize(self, req: ColumnRequest) -> np.ndarray:
+        if req.key not in self._arrays:
+            self._arrays[req.key] = self._data.materialize(req)
+        return self._arrays[req.key]
+
+    def row_mask(self) -> np.ndarray:
+        if self._row_mask is None:
+            self._row_mask = np.ones(self._data.num_rows, dtype=bool)
+        return self._row_mask
+
+
+def _full_batch(
+    data: Dataset, requests, cache: Optional[_OracleCache] = None
+) -> Dict[str, np.ndarray]:
+    mat = cache.materialize if cache is not None else data.materialize
+    batch = {r.key: mat(r) for r in requests}
     for r in requests:
         mask_key = f"{r.column}::mask"
         if mask_key not in batch:
-            batch[mask_key] = data.materialize(
-                ColumnRequest(r.column, "mask")
-            )
-    batch[ROW_MASK] = np.ones(data.num_rows, dtype=bool)
+            batch[mask_key] = mat(ColumnRequest(r.column, "mask"))
+    batch[ROW_MASK] = (
+        cache.row_mask()
+        if cache is not None
+        else np.ones(data.num_rows, dtype=bool)
+    )
     return batch
 
 
-def _where_pass(where: Optional[str], data: Dataset) -> Optional[np.ndarray]:
+def _where_pass(
+    where: Optional[str],
+    data: Dataset,
+    cache: Optional[_OracleCache] = None,
+) -> Optional[np.ndarray]:
     """True for rows EXCLUDED by the filter (they pass by default)."""
     if where is None:
         return None
+    if cache is not None and where in cache._where:
+        return cache._where[where]
     pred = compile_predicate(where, data)
-    batch = _full_batch(data, pred.requests)
-    return ~np.asarray(jax.device_get(pred.complies(batch)), dtype=bool)
+    batch = _full_batch(data, pred.requests, cache)
+    out = ~np.asarray(jax.device_get(pred.complies(batch)), dtype=bool)
+    if cache is not None:
+        cache._where[where] = out
+    return out
 
 
 def _asserted_per_value(
@@ -111,13 +152,16 @@ def _outcome_for(
     data: Dataset,
     assertion=None,
     excluded: Optional[np.ndarray] = None,
+    cache: Optional[_OracleCache] = None,
 ) -> Optional[np.ndarray]:
+    mat = cache.materialize if cache is not None else data.materialize
+
     def _asserted(repr_name: str) -> Optional[np.ndarray]:
         values = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, repr_name))
+            mat(ColumnRequest(analyzer.column, repr_name))
         )
         valid = np.asarray(
-            data.materialize(ColumnRequest(analyzer.column, "mask")),
+            mat(ColumnRequest(analyzer.column, "mask")),
             dtype=bool,
         )
         if excluded is not None:
@@ -137,19 +181,19 @@ def _outcome_for(
             return None
         out = _asserted("values")
     elif isinstance(analyzer, Completeness):
-        mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
+        mask = mat(ColumnRequest(analyzer.column, "mask"))
         out = np.asarray(mask, dtype=bool).copy()
     elif isinstance(analyzer, Compliance):
         pred = compile_predicate(analyzer.predicate, data)
-        batch = _full_batch(data, pred.requests)
+        batch = _full_batch(data, pred.requests, cache)
         out = np.asarray(
             jax.device_get(pred.complies(batch)), dtype=bool
         ).copy()
     elif isinstance(analyzer, PatternMatch):
         import re
 
-        codes = data.materialize(ColumnRequest(analyzer.column, "codes"))
-        mask = data.materialize(ColumnRequest(analyzer.column, "mask"))
+        codes = mat(ColumnRequest(analyzer.column, "codes"))
+        mask = mat(ColumnRequest(analyzer.column, "mask"))
         dictionary = data.dictionary(analyzer.column)
         prog = re.compile(analyzer.pattern)
         lut = np.zeros(max(len(dictionary), 1) + 1, dtype=bool)
@@ -169,10 +213,8 @@ def _outcome_for(
         for c in columns:
             kind = data.schema.kind_of(c)
             repr_name = "codes" if kind == Kind.STRING else "values"
-            values = np.asarray(data.materialize(ColumnRequest(c, repr_name)))
-            mask = np.asarray(
-                data.materialize(ColumnRequest(c, "mask")), dtype=bool
-            )
+            values = np.asarray(mat(ColumnRequest(c, repr_name)))
+            mask = np.asarray(mat(ColumnRequest(c, "mask")), dtype=bool)
             _, col_ids = np.unique(values, return_inverse=True)
             # validity joins the key so NULL is its own value,
             # distinct from the zero-fill
@@ -221,6 +263,9 @@ def row_level_results(
             f"{filtered_row_outcome!r}"
         )
     columns: Dict[str, pa.Array] = {}
+    # one shared materialization cache for the whole export: only the
+    # columns the row-level constraints touch, each loaded once
+    cache = _OracleCache(data)
     for check, result in check_results.items():
         for cr in result.constraint_results:
             constraint = cr.constraint
@@ -232,13 +277,15 @@ def row_level_results(
                 continue
             try:
                 excluded = _where_pass(
-                    getattr(inner.analyzer, "where", None), data
+                    getattr(inner.analyzer, "where", None), data,
+                    cache,
                 )
                 outcome = _outcome_for(
                     inner.analyzer,
                     data,
                     assertion=inner.assertion,
                     excluded=excluded,
+                    cache=cache,
                 )
             except Exception:  # noqa: BLE001 — degrade: an unplannable
                 # predicate (compile_predicate in _where_pass or the

@@ -53,6 +53,9 @@ class VerificationResult:
         # interruption.checkpointed says whether a resume cursor was
         # persisted; None = ran to completion (set by the suite)
         self.interruption = None
+        # egress.EgressReport when the run streamed row-level outcomes
+        # to a clean/quarantine split (row_level_sink=); None otherwise
+        self.row_level_egress = None
 
     def row_level_results_as_dataset(
         self,
@@ -132,30 +135,57 @@ class VerificationSuite:
         save_or_append_results_with_key=None,
         deadline=None,
         cancel=None,
+        row_level_sink=None,
     ) -> VerificationResult:
         """Run all checks. ``deadline`` (seconds or a ``RunBudget``) and
         ``cancel`` (a ``CancelToken``) bound the run — an interrupt
         still returns a result: partial metrics, the overall status
         floored per ``config.degradation_policy``, and
         ``result.interruption`` carrying the provenance
-        (docs/RESILIENCE.md, "Deadlines & cancellation")."""
+        (docs/RESILIENCE.md, "Deadlines & cancellation").
+
+        ``row_level_sink`` (an ``egress.RowLevelSink``): stream per-row
+        pass/fail outcomes to a partitioned clean/quarantine parquet
+        split INSIDE the same fused scan — ``result.row_level_egress``
+        reports what was written (docs/EGRESS.md)."""
         analyzers = list(required_analyzers) + [
             a for check in checks for a in check.required_analyzers()
         ]
-        context = AnalysisRunner.do_analysis_run(
-            data,
-            analyzers,
-            aggregate_with=aggregate_with,
-            save_states_with=save_states_with,
-            engine=engine,
-            metrics_repository=metrics_repository,
-            reuse_existing_results_for_key=reuse_existing_results_for_key,
-            fail_if_results_missing=fail_if_results_missing,
-            save_or_append_results_with_key=save_or_append_results_with_key,
-            deadline=deadline,
-            cancel=cancel,
-        )
-        return VerificationSuite.evaluate(checks, context, data=data)
+        sink_plan = None
+        if row_level_sink is not None:
+            from deequ_tpu.egress import finalize_row_sink, plan_row_sink
+
+            engine = engine or AnalysisEngine()
+            sink_plan = plan_row_sink(row_level_sink, checks, data, engine)
+        try:
+            context = AnalysisRunner.do_analysis_run(
+                data,
+                analyzers,
+                aggregate_with=aggregate_with,
+                save_states_with=save_states_with,
+                engine=engine,
+                metrics_repository=metrics_repository,
+                reuse_existing_results_for_key=reuse_existing_results_for_key,
+                fail_if_results_missing=fail_if_results_missing,
+                save_or_append_results_with_key=save_or_append_results_with_key,
+                deadline=deadline,
+                cancel=cancel,
+                row_sink=sink_plan,
+            )
+        except BaseException:
+            if sink_plan is not None:
+                sink_plan.mark_scan_failed()
+                finalize_row_sink(sink_plan, data, engine)
+            raise
+        result = VerificationSuite.evaluate(checks, context, data=data)
+        if row_level_sink is not None:
+            if sink_plan is not None:
+                result.row_level_egress = finalize_row_sink(
+                    sink_plan, data, engine
+                )
+            else:
+                result.row_level_egress = row_level_sink.report
+        return result
 
     @staticmethod
     def do_coalesced_verification_run(
@@ -302,6 +332,7 @@ class VerificationRunBuilder:
         self._anomaly_checks: List = []
         self._deadline = None
         self._cancel = None
+        self._row_level_sink = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -334,6 +365,13 @@ class VerificationRunBuilder:
         """Attach a ``CancelToken`` — cancelling it mid-run exits the
         scan cleanly with partial metrics + a resumable checkpoint."""
         self._cancel = cancel
+        return self
+
+    def with_row_level_sink(self, sink) -> "VerificationRunBuilder":
+        """Stream per-row pass/fail outcomes to a clean/quarantine
+        parquet split (an ``egress.RowLevelSink``) inside the same
+        fused scan — docs/EGRESS.md."""
+        self._row_level_sink = sink
         return self
 
     def aggregate_with(self, state_loader) -> "VerificationRunBuilder":
@@ -406,4 +444,5 @@ class VerificationRunBuilder:
             save_or_append_results_with_key=self._save_key,
             deadline=self._deadline,
             cancel=self._cancel,
+            row_level_sink=self._row_level_sink,
         )

@@ -1,0 +1,392 @@
+"""Streaming row-level egress (docs/EGRESS.md): the clean/quarantine
+parquet split written DURING the fused scan must be bit-equal to the
+in-memory oracle (``verification/rowlevel.py``) — per constraint, per
+row — on the resident, streaming and mesh paths, under both
+filtered-row semantics; quarantined-batch degradation folds into the
+SAME artifact with provenance; and the pass accounting is honest
+(``engine.data_passes == 1`` for scan-only suites, ``2`` when a
+deferred family forces the oracle's second look).
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu import Check, CheckLevel, config
+from deequ_tpu.analyzers import Completeness, Mean, Size, Uniqueness
+from deequ_tpu.data import Dataset
+from deequ_tpu.egress import BATCH_QUARANTINED, RowLevelSink
+from deequ_tpu.engine.resilience import RetryPolicy
+from deequ_tpu.engine.scan import AnalysisEngine
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.testing.faults import FaultInjectingDataset
+from deequ_tpu.verification.rowlevel import row_level_results
+from deequ_tpu.verification.suite import VerificationSuite
+
+NO_SLEEP = RetryPolicy(max_attempts=1, sleep=lambda s: None)
+
+#: forces the resident chunk cache / the streaming wire respectively
+RESIDENT = {"device_cache_bytes": 1 << 30}
+STREAMING = {"device_cache_bytes": 0}
+
+
+def _make_data(n=1000, seed=7) -> Dataset:
+    rng = np.random.default_rng(seed)
+    v = rng.integers(0, 120, size=n)
+    s = [
+        None if rng.random() < 0.08 else f"u{int(x):03d}@ex.com"
+        for x in rng.integers(0, 40, size=n)
+    ]
+    u = rng.integers(0, n // 2, size=n)  # guaranteed duplicates
+    return Dataset.from_pydict(
+        {"v": v.tolist(), "s": s, "u": u.tolist()}
+    )
+
+
+def _scan_checks():
+    """Mask/predicate + pattern + traceable asserted-value: every
+    family that rides the scan (one pass, no deferred phase)."""
+    return [
+        Check(CheckLevel.ERROR, "scan families")
+        .is_complete("s")
+        .satisfies("v < 90", "v_small")
+        .where("v >= 10")
+        .has_pattern("s", r"@ex\.com$")
+        .has_min("v", lambda x: x >= 0)
+    ]
+
+
+def _full_checks():
+    """Scan families plus Uniqueness — the always-deferred family."""
+    return _scan_checks() + [
+        Check(CheckLevel.WARNING, "deferred").is_unique("u")
+    ]
+
+
+def _read_artifact(report):
+    """Concatenate the split back in source order and sanity-check the
+    partitioning invariant: clean + quarantined == input, disjoint."""
+    clean = pq.read_table(
+        os.path.join(report.clean_dir, "part-00000.parquet")
+    )
+    quarantine = pq.read_table(
+        os.path.join(report.quarantine_dir, "part-00000.parquet")
+    )
+    shared = [
+        c for c in clean.schema.names if c in set(quarantine.schema.names)
+    ]
+    merged = pa.concat_tables(
+        [clean.select(shared), quarantine.select(shared)]
+    )
+    order = np.argsort(
+        np.asarray(merged.column("__row_index__").to_pylist())
+    )
+    merged = merged.take(pa.array(order))
+    idx = merged.column("__row_index__").to_pylist()
+    assert idx == list(range(report.rows_total))
+    return clean, quarantine, merged
+
+
+def _run_with_sink(data, checks, tmp_path, outcome="true", engine=None,
+                   columns=None):
+    sink = RowLevelSink(
+        str(tmp_path / "egress"),
+        filtered_row_outcome=outcome,
+        columns=columns,
+        tenant="acme",
+        run_id="r1",
+    )
+    result = VerificationSuite.do_verification_run(
+        data, checks, engine=engine, row_level_sink=sink
+    )
+    return result, result.row_level_egress
+
+
+class TestDifferentialAgainstOracle:
+    """Satellite 1: the streamed artifact equals the in-memory oracle,
+    column for column, row for row."""
+
+    @pytest.mark.parametrize("mode", ["resident", "streaming"])
+    @pytest.mark.parametrize("outcome", ["true", "null"])
+    def test_bit_equal_outcomes(self, tmp_path, mode, outcome):
+        data = _make_data()
+        cfg = RESIDENT if mode == "resident" else STREAMING
+        with config.configure(batch_size=104, **cfg):
+            result, report = _run_with_sink(
+                data, _full_checks(), tmp_path, outcome=outcome
+            )
+        assert report.status == "complete"
+        assert report.rows_clean + report.rows_quarantined == 1000
+        assert set(report.constraints.values()) == {"scan", "deferred"}
+        oracle = row_level_results(
+            result.check_results, data, filtered_row_outcome=outcome
+        ).table
+        _, _, merged = _read_artifact(report)
+        assert len(oracle.schema.names) >= 5
+        for name in oracle.schema.names:
+            assert (
+                merged.column(name).to_pylist()
+                == oracle.column(name).to_pylist()
+            ), f"outcome column diverged: {name} ({mode}/{outcome})"
+
+    def test_clean_rows_pass_everything(self, tmp_path):
+        data = _make_data()
+        with config.configure(batch_size=104, **STREAMING):
+            result, report = _run_with_sink(
+                data, _full_checks(), tmp_path
+            )
+        clean, quarantine, _ = _read_artifact(report)
+        oracle = row_level_results(result.check_results, data).table
+        for name in oracle.schema.names:
+            assert all(clean.column(name).to_pylist())
+        # every quarantined row fails at least one constraint, and
+        # says which
+        labels = quarantine.column("__failed_constraints__").to_pylist()
+        assert all(labels)
+        fail_any = np.zeros(len(quarantine), dtype=bool)
+        for name in oracle.schema.names:
+            col = quarantine.column(name).to_pylist()
+            fail_any |= np.array([x is False for x in col])
+        assert fail_any.all()
+
+    def test_failed_row_counts_match_aggregate_metrics(self, tmp_path):
+        """Satellite 1: per-constraint failed-row counts are the same
+        numbers the aggregate metrics report."""
+        n = 1000
+        data = _make_data(n)
+        checks = [
+            Check(CheckLevel.ERROR, "agg")
+            .is_complete("s")
+            .satisfies("v < 90", "v_small")
+            .is_unique("u")
+        ]
+        with config.configure(batch_size=104, **STREAMING):
+            result, report = _run_with_sink(data, checks, tmp_path)
+        _, _, merged = _read_artifact(report)
+
+        def failed(fragment):
+            (name,) = [
+                c for c in merged.schema.names if fragment in c
+            ]
+            col = merged.column(name).to_pylist()
+            return sum(1 for x in col if x is False)
+
+        metrics = {
+            type(a).__name__: m.value.get()
+            for a, m in result.metrics.items()
+        }
+        assert failed("Completeness") == n - round(
+            metrics["Completeness"] * n
+        )
+        assert failed("v_small") == n - round(metrics["Compliance"] * n)
+        assert failed("Uniqueness") == n - round(
+            metrics["Uniqueness"] * n
+        )
+
+    def test_scan_only_suite_is_one_pass(self, tmp_path):
+        """Acceptance criterion: mask/predicate suites stream the split
+        in the SAME single pass the metrics ride."""
+        data = _make_data()
+        tm = get_telemetry()
+        with config.configure(batch_size=104, **STREAMING):
+            before = tm.counter("engine.data_passes").value
+            _, report = _run_with_sink(data, _scan_checks(), tmp_path)
+            delta = tm.counter("engine.data_passes").value - before
+        assert delta == 1
+        assert set(report.constraints.values()) == {"scan"}
+
+    def test_deferred_suite_is_honestly_two_passes(self, tmp_path):
+        data = _make_data()
+        tm = get_telemetry()
+        with config.configure(batch_size=104, **STREAMING):
+            before = tm.counter("engine.data_passes").value
+            _, report = _run_with_sink(data, _full_checks(), tmp_path)
+            delta = tm.counter("engine.data_passes").value - before
+        assert delta == 2
+        assert "deferred" in report.constraints.values()
+
+    def test_mesh_path_matches_oracle(self, tmp_path, cpu_mesh):
+        data = _make_data(600)
+        engine = AnalysisEngine(mesh=cpu_mesh)
+        with config.configure(batch_size=104, **STREAMING):
+            result, report = _run_with_sink(
+                data, _full_checks(), tmp_path, engine=engine
+            )
+        oracle = row_level_results(result.check_results, data).table
+        _, _, merged = _read_artifact(report)
+        for name in oracle.schema.names:
+            assert (
+                merged.column(name).to_pylist()
+                == oracle.column(name).to_pylist()
+            )
+
+    def test_column_projection_and_provenance(self, tmp_path):
+        data = _make_data()
+        with config.configure(batch_size=104, **STREAMING):
+            _, report = _run_with_sink(
+                data, _scan_checks(), tmp_path, columns=["v"]
+            )
+        clean, quarantine, _ = _read_artifact(report)
+        for split in (clean, quarantine):
+            names = set(split.schema.names)
+            assert "v" in names and "s" not in names and "u" not in names
+            assert {"__row_index__", "__batch_seq__"} <= names
+        # the heavier provenance is quarantine-only: the clean split
+        # stays lean (docs/EGRESS.md)
+        assert {
+            "__failed_constraints__",
+            "__error_class__",
+            "__tenant__",
+            "__run_id__",
+        } <= set(quarantine.schema.names)
+        assert set(quarantine.column("__tenant__").to_pylist()) <= {"acme"}
+        manifest = json.loads(
+            open(report.manifest_path, encoding="utf-8").read()
+        )
+        assert manifest["status"] == "complete"
+
+
+class TestDegradationFoldIn:
+    """Acceptance criterion: quarantined-batch degradation (PR 3) folds
+    into the SAME egress artifact — whole failed units land in the
+    quarantine split with BatchFailure provenance and NULL outcomes."""
+
+    @pytest.mark.parametrize("mode", ["resident", "streaming"])
+    def test_failed_unit_lands_in_quarantine(self, tmp_path, mode):
+        n = 1000
+        data = FaultInjectingDataset(
+            _make_data(n), permanent={3}
+        )
+        cfg = RESIDENT if mode == "resident" else STREAMING
+        with config.configure(
+            batch_size=104, scan_retry=NO_SLEEP, **cfg
+        ):
+            result, report = _run_with_sink(
+                data, _scan_checks(), tmp_path
+            )
+        assert report.status == "complete"
+        clean, quarantine, _ = _read_artifact(report)
+        labels = quarantine.column("__failed_constraints__").to_pylist()
+        failed_rows = [
+            i
+            for i, lab in zip(
+                quarantine.column("__row_index__").to_pylist(), labels
+            )
+            if lab == BATCH_QUARANTINED
+        ]
+        # batch 3 = rows 312..415; both granularities cover it whole
+        assert set(range(312, 416)) <= set(failed_rows)
+        err = {
+            lab: ec
+            for lab, ec in zip(
+                labels, quarantine.column("__error_class__").to_pylist()
+            )
+        }
+        assert err[BATCH_QUARANTINED] == "ValueError"
+        # outcome columns are NULL on quarantined-batch rows: the scan
+        # never produced their bits
+        for name in report.constraints:
+            col = quarantine.column(name).to_pylist()
+            for i, lab in enumerate(labels):
+                if lab == BATCH_QUARANTINED:
+                    assert col[i] is None
+        # the manifest carries the same provenance the degradation
+        # record reports
+        manifest = json.loads(
+            open(report.manifest_path, encoding="utf-8").read()
+        )
+        assert manifest["scan_failures"], manifest
+        assert (
+            manifest["scan_failures"][0]["error_class"] == "ValueError"
+        )
+        assert result.degradation is not None
+
+
+class TestPlanningAndLimits:
+    def test_no_row_level_constraints_reports_and_skips(self, tmp_path):
+        data = _make_data(100)
+        checks = [
+            Check(CheckLevel.ERROR, "agg only").has_size(
+                lambda s: s == 100
+            )
+        ]
+        sink = RowLevelSink(str(tmp_path / "egress"))
+        result = VerificationSuite.do_verification_run(
+            data, checks, row_level_sink=sink
+        )
+        report = result.row_level_egress
+        assert report is sink.report
+        assert report.status == "no_row_level_constraints"
+        assert not os.path.exists(str(tmp_path / "egress" / "clean"))
+
+    def test_checkpointer_composition_is_refused(self, tmp_path):
+        from deequ_tpu.egress import plan_row_sink
+
+        data = _make_data(100)
+        engine = types.SimpleNamespace(checkpointer=object())
+        with pytest.raises(ValueError, match="checkpoint"):
+            plan_row_sink(
+                RowLevelSink(str(tmp_path / "e")),
+                _scan_checks(),
+                data,
+                engine,
+            )
+
+    def test_bad_filtered_row_outcome_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="filtered_row_outcome"):
+            RowLevelSink(str(tmp_path / "e"), filtered_row_outcome="drop")
+
+
+class TestServiceIntegration:
+    """The sink is per-run state: service runs carrying one never
+    coalesce and never cross the subprocess-isolation boundary."""
+
+    def test_sink_runs_refuse_to_coalesce(self):
+        from deequ_tpu.service.coalesce import CoalescePolicy
+        from deequ_tpu.service.queue import Priority
+
+        policy = CoalescePolicy(enabled=True)
+        sinkful = types.SimpleNamespace(
+            payload=types.SimpleNamespace(row_level_sink=object()),
+            handle=types.SimpleNamespace(priority=Priority.BATCH),
+        )
+        sinkless = types.SimpleNamespace(
+            payload=types.SimpleNamespace(row_level_sink=None),
+            handle=types.SimpleNamespace(priority=Priority.BATCH),
+        )
+        assert not policy.may_coalesce(sinkful)
+        assert policy.may_coalesce(sinkless)
+
+    def test_service_run_streams_the_split(self, tmp_path):
+        from deequ_tpu.service.service import (
+            RunRequest,
+            VerificationService,
+        )
+
+        data = _make_data(500)
+        sink = RowLevelSink(str(tmp_path / "egress"))
+        svc = VerificationService(workers=1).start()
+        try:
+            with config.configure(batch_size=104, **STREAMING):
+                handle = svc.submit(
+                    RunRequest(
+                        tenant="acme",
+                        checks=tuple(_scan_checks()),
+                        dataset_key="t",
+                        dataset_factory=lambda: data,
+                        row_level_sink=sink,
+                    )
+                )
+                assert handle.wait(timeout=60)
+                result = handle.result(timeout=0)
+        finally:
+            svc.stop(drain=False, timeout=10)
+        report = result.row_level_egress
+        assert report is not None and report.status == "complete"
+        _, _, merged = _read_artifact(report)
+        assert len(merged) == 500

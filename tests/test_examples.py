@@ -28,6 +28,7 @@ _IN_PROCESS = [
     "mesh_execution",
     "production_pipeline",
     "profiling_and_suggestion",
+    "rowlevel_quarantine",
     "verification_service",
 ]
 

@@ -814,6 +814,115 @@ class TestWireDiscipline:
 
 
 # --------------------------------------------------------------------------
+# wire-discipline: egress extension (rules 3 and 4)
+# --------------------------------------------------------------------------
+
+
+EGRESS_HOARDING_CONSUME = """
+    class Writer:
+        def __init__(self):
+            self._rows = []
+
+        def consume(self, bits, valid):
+            self._rows.append((bits, valid))
+"""
+
+EGRESS_FLUSHING_CONSUME = """
+    class Writer:
+        def __init__(self, spool):
+            self._spool = spool
+
+        def consume(self, bits, valid):
+            self._spool.write(bits)
+            self._spool.flush()
+"""
+
+EGRESS_EMITTING_CONSUME = """
+    class Writer:
+        def consume(self, bits, valid):
+            self._pending.append(valid)
+            self._emit_span(bits, valid)
+"""
+
+
+class TestWireDisciplineEgress:
+    def test_catches_device_put_in_egress_writer(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/egress/writer.py", WIRE_DATA_PUT)
+        found = _rules_found(tmp_path, "wire-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "jax.device_put"
+        assert "egress" in found[0].message
+
+    def test_plan_module_is_the_device_half(self, tmp_path):
+        """egress/plan.py builds the on-device bit-pack planes; jit and
+        device calls there are the design, not a violation."""
+        _write(tmp_path, "deequ_tpu/egress/plan.py", WIRE_DATA_PUT)
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_catches_unflushed_consume_buffering(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/egress/writer.py",
+            EGRESS_HOARDING_CONSUME,
+        )
+        found = _rules_found(tmp_path, "wire-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "consume"
+        assert "flush per scan fold" in found[0].message
+
+    def test_silent_when_consume_writes_through(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/egress/writer.py",
+            EGRESS_FLUSHING_CONSUME,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_emit_helper_counts_as_write_through(self, tmp_path):
+        """The direct (non-spool) consume path flushes via _emit —
+        the heuristic must recognize it, or the real writer trips."""
+        _write(
+            tmp_path,
+            "deequ_tpu/egress/writer.py",
+            EGRESS_EMITTING_CONSUME,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_buffering_outside_consume_is_fine(self, tmp_path):
+        """Bounded accumulation elsewhere (e.g. the pending-failure
+        list, refreshed per degradation record) is legitimate; only
+        the per-fold consume path carries the flush contract."""
+        _write(
+            tmp_path,
+            "deequ_tpu/egress/writer.py",
+            """
+            class Writer:
+                def refresh_failures(self, record):
+                    self._pending.append(record)
+            """,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_consume_buffering_outside_egress_is_fine(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/collector.py",
+            EGRESS_HOARDING_CONSUME,
+        )
+        assert _rules_found(tmp_path, "wire-discipline") == []
+
+    def test_real_egress_package_is_clean(self):
+        findings = [
+            f
+            for f in unwaived(
+                run_analyzers(str(REPO_ROOT), rules=["wire-discipline"])
+            )
+            if f.path.startswith("deequ_tpu/egress/")
+        ]
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
 # CLI / JSON artifact
 # --------------------------------------------------------------------------
 

@@ -32,7 +32,7 @@ from deequ_tpu.telemetry.oprecords import (
     operational_metrics,
     operational_values,
 )
-from fixtures import df_numeric
+from fixtures import df_numeric, df_numeric_with_nulls
 
 
 # --------------------------------------------------------------------------
@@ -520,6 +520,32 @@ class TestTools:
         assert "scan" in out
         assert "counters (delta over run):" in out
         assert "engine.scans" in out
+
+    def test_obs_report_egress_line(self, tmp_path, capsys):
+        """A row-level-sink run renders the egress line: rows split,
+        bytes/row out, encode share (docs/EGRESS.md)."""
+        from deequ_tpu import telemetry
+        from deequ_tpu.checks import Check, CheckLevel
+        from deequ_tpu.egress import RowLevelSink
+        from deequ_tpu.verification.suite import VerificationSuite
+        from tools.obs_report import main as report_main
+
+        path = str(tmp_path / "runs.jsonl")
+        telemetry.configure(jsonl_path=path)
+        try:
+            VerificationSuite.do_verification_run(
+                df_numeric_with_nulls(),
+                [Check(CheckLevel.ERROR, "c").is_complete("att1")],
+                row_level_sink=RowLevelSink(str(tmp_path / "egress")),
+            )
+        finally:
+            telemetry.configure(jsonl_path=None)
+        report_main([path])
+        out = capsys.readouterr().out
+        assert "egress: " in out
+        assert "clean /" in out and "quarantined" in out
+        assert "bytes/row out" in out
+        assert "encode share" in out
 
     def test_hot_paths_have_no_adhoc_timing(self):
         """The lint satellite: every clock/trace call outside

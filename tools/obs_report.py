@@ -113,6 +113,47 @@ def render_ingest_pool(summary: Dict[str, Any]) -> str:
     return "  ingest pool: " + ", ".join(parts)
 
 
+def render_egress(records: List[Dict[str, Any]]) -> str:
+    """The row-level egress line (docs/EGRESS.md), one per sink run:
+    how the rows split across the clean/quarantine parquet artifact,
+    the outbound bytes per row (raw -> encoded), and the encode share —
+    what fraction of the raw outbound bytes the wire actually carried.
+    The ``rowlevel_egress`` event is emitted at finalize, AFTER the
+    run's telemetry summary closes, so this reads top-level event
+    lines. Empty string when no run streamed a row-level sink."""
+    events = [
+        r for r in records
+        if r.get("type") == "event"
+        and r.get("event") == "rowlevel_egress"
+    ]
+    if not events:
+        return ""
+    lines = []
+    for e in events:
+        clean = int(e.get("rows_clean", 0))
+        quarantined = int(e.get("rows_quarantined", 0))
+        raw = float(e.get("bytes_raw", 0))
+        encoded = float(e.get("bytes_encoded", 0))
+        rows = clean + quarantined
+        parts = [f"{clean:,} clean / {quarantined:,} quarantined"]
+        if rows > 0 and raw > 0:
+            parts.append(
+                f"{raw / rows:.1f} -> {encoded / rows:.1f} bytes/row out"
+            )
+            parts.append(f"encode share {100.0 * encoded / raw:.0f}%")
+        status = str(e.get("status", "?"))
+        if status != "complete":
+            parts.append(f"status {status}")
+        n_constraints = int(e.get("constraints", 0))
+        if n_constraints:
+            parts.append(f"{n_constraints} constraint(s)")
+        tenant = str(e.get("tenant", ""))
+        if tenant:
+            parts.append(f"tenant {tenant}")
+        lines.append("egress: " + ", ".join(parts))
+    return "\n".join(lines)
+
+
 def render_run(summary: Dict[str, Any]) -> str:
     """One run's breakdown: pass table, wall decomposition, counters."""
     lines = []
@@ -582,6 +623,9 @@ def render(
         )
     body = "\n\n".join(render_run(r) for r in runs)
     if run_id is None:
+        egress_section = render_egress(records)
+        if egress_section:
+            body = body + "\n\n" + egress_section
         section = render_service(records)
         if section:
             body = body + "\n\n" + section

@@ -3,7 +3,7 @@ wire dtype decisions stay out of per-batch loops.
 
 The wire diet (docs/PERF.md) only works if layering holds:
 
-``wire-discipline`` — two checks over the wire path:
+``wire-discipline`` — checks over the wire path (ingest AND egress):
 
 1. Modules under ``deequ_tpu/data/`` may not call ``jax.device_put``
    or ``jax.jit`` (or ``jax.pmap``). Device placement belongs to the
@@ -25,6 +25,19 @@ The wire diet (docs/PERF.md) only works if layering holds:
    docstring): one cold batch widens the wire and retraces the fused
    scan. Narrowing is decided once per run — from parquet statistics,
    a first-batch probe, or the whole materialized column.
+
+3. The egress writer (``deequ_tpu/egress/``, every module except
+   ``plan.py`` — the declared device half) is HOST-ONLY, the mirror
+   image of rule 1: row-level bit planes arrive through the scan's
+   packed epilogue, and a device call in the writer would open a
+   second unaccounted device channel on the way OUT.
+
+4. Egress scan-phase consumption must flush per fold: inside a
+   ``consume*`` function in an egress module, a ``.append(...)`` /
+   ``.extend(...)`` hoards host memory unless the same function also
+   writes through (``.write`` / ``.flush`` / ``.write_table`` or an
+   ``_emit*`` helper). The writer's host footprint is bounded by ONE
+   span — never the table (docs/EGRESS.md "Memory discipline").
 """
 
 from __future__ import annotations
@@ -54,6 +67,14 @@ WIRE_PATH_FILES = (
 NARROWING_TAILS = frozenset(
     {"narrow_int64_values", "narrow_codes", "narrowest_int_dtype"}
 )
+EGRESS_PREFIX = "deequ_tpu/egress/"
+#: the one egress module ALLOWED to touch jax: it builds the on-device
+#: bit-pack planes that ride the fused scan (docs/EGRESS.md)
+EGRESS_DEVICE_HALF = "deequ_tpu/egress/plan.py"
+#: calls that accumulate host memory inside a consume path
+BUFFERING_TAILS = frozenset({"append", "extend"})
+#: calls that prove the consume path writes through per fold
+FLUSH_TAILS = frozenset({"write", "flush", "write_table"})
 
 
 class _WireScanner(ast.NodeVisitor):
@@ -64,6 +85,9 @@ class _WireScanner(ast.NodeVisitor):
         self.loop_depth = 0
         self.device_calls: List[Tuple[str, int]] = []
         self.looped_narrowing: List[Tuple[str, int]] = []
+        #: buffering calls inside ``consume*`` functions that never
+        #: lexically write through: (function name, callee, line)
+        self.hoarding: List[Tuple[str, str, int]] = []
 
     def _visit_loop(self, node: ast.AST) -> None:
         self.loop_depth += 1
@@ -89,13 +113,45 @@ class _WireScanner(ast.NodeVisitor):
                 self.looped_narrowing.append((tail, node.lineno))
         self.generic_visit(node)
 
+    def _visit_consume(self, node: ast.AST) -> None:
+        """A ``consume*`` function is the scan's per-fold host sink;
+        flag buffering calls unless the SAME function lexically writes
+        through (``.flush``/``.write``/``.write_table`` or an
+        ``_emit*`` helper — the writer's emit path is the flush)."""
+        name = getattr(node, "name", "")
+        if not name.startswith("consume"):
+            self.generic_visit(node)
+            return
+        buffered: List[Tuple[str, int]] = []
+        flushes = False
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            callee = dotted_name(inner.func)
+            if not callee:
+                continue
+            tail = callee.split(".")[-1]
+            if tail in BUFFERING_TAILS and "." in callee:
+                buffered.append((callee, inner.lineno))
+            if tail in FLUSH_TAILS or tail.startswith("_emit"):
+                flushes = True
+        if not flushes:
+            self.hoarding.extend(
+                (name, callee, line) for callee, line in buffered
+            )
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_consume
+    visit_AsyncFunctionDef = _visit_consume
+
 
 class WireDisciplineAnalyzer(Analyzer):
     name = "wire"
     rules = ("wire-discipline",)
     description = (
-        "device placement calls in the host-only data layer; "
-        "per-batch wire-narrowing decisions in loops"
+        "device placement calls in the host-only data layer or egress "
+        "writer; per-batch wire-narrowing decisions in loops; "
+        "unflushed host buffering in egress consume paths"
     )
 
     def analyze(
@@ -104,7 +160,13 @@ class WireDisciplineAnalyzer(Analyzer):
         for sf in files:
             in_data = sf.rel.startswith(DATA_PREFIX)
             in_wire_path = sf.rel in WIRE_PATH_FILES
-            if not (in_data or in_wire_path) or sf.tree is None:
+            in_egress = sf.rel.startswith(EGRESS_PREFIX)
+            host_only_egress = (
+                in_egress and sf.rel != EGRESS_DEVICE_HALF
+            )
+            if not (in_data or in_wire_path or in_egress):
+                continue
+            if sf.tree is None:
                 continue
             scanner = _WireScanner()
             scanner.visit(sf.tree)
@@ -122,6 +184,39 @@ class WireDisciplineAnalyzer(Analyzer):
                             "and bypasses transfer accounting"
                         ),
                         symbol=callee,
+                    )
+            if host_only_egress:
+                for callee, line in scanner.device_calls:
+                    yield Finding(
+                        rule="wire-discipline",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"'{callee}' in the host-only egress "
+                            "writer: device evaluation belongs to the "
+                            "scan's plane functions (egress/plan.py); "
+                            "bit planes arrive through the packed "
+                            "epilogue — a writer-side device call "
+                            "opens a second unaccounted device channel"
+                        ),
+                        symbol=callee,
+                    )
+            if in_egress:
+                for fn, callee, line in scanner.hoarding:
+                    yield Finding(
+                        rule="wire-discipline",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"'{callee}' buffers host memory inside "
+                            f"'{fn}' without a lexical write-through "
+                            "(.write/.flush/.write_table/_emit*): the "
+                            "egress consume path must flush per scan "
+                            "fold — its host footprint is bounded by "
+                            "one span, never the table "
+                            "(docs/EGRESS.md)"
+                        ),
+                        symbol=fn,
                     )
             if in_wire_path:
                 for tail, line in scanner.looped_narrowing:
