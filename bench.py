@@ -144,6 +144,9 @@ CONFIG_REGISTRY = {
     "service_coalesced_suites": (
         lambda a: bench_service_coalesced_suites(a["rows"], a["clients"])
     ),
+    "service_elastic_placement": (
+        lambda a: bench_service_elastic_placement(a["rows"], a["clients"])
+    ),
     "spill_grouping_12M_distinct": lambda a: bench_spill_grouping(a["rows"]),
     "joint_grouping_mi_1Mcard_pair": lambda a: bench_joint_grouping(a["rows"]),
     "streaming_parquet": (
@@ -156,6 +159,44 @@ CONFIG_REGISTRY = {
     "streaming_bundle_100m": lambda a: bench_streaming_bundle_100m(a["rows"]),
     "rowlevel_egress": lambda a: bench_rowlevel_egress(a["rows"]),
 }
+
+
+#: extra environment a config's spawned child needs, applied by
+#: ``run_one`` around the spawn and restored after (the parent's
+#: already-initialized jax backend is unaffected — only the child's
+#: fresh import reads it). ``service_elastic_placement`` measures
+#: sub-slice placement, which needs a multi-device pool; on a CPU
+#: host that means forcing virtual host devices.
+CONFIG_CHILD_ENV = {
+    "service_elastic_placement": {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    },
+}
+
+
+def _apply_child_env(name: str):
+    """Set a config's CONFIG_CHILD_ENV vars, returning a restore
+    thunk. XLA_FLAGS composes: an existing device-count flag wins
+    (the caller already chose a pool size), anything else is appended
+    to rather than clobbered."""
+    saved = {}
+    for key, value in CONFIG_CHILD_ENV.get(name, {}).items():
+        prior = os.environ.get(key)
+        saved[key] = prior
+        if key == "XLA_FLAGS" and prior:
+            if "xla_force_host_platform_device_count" in prior:
+                continue
+            value = f"{prior} {value}"
+        os.environ[key] = value
+
+    def restore():
+        for key, prior in saved.items():
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
+
+    return restore
 
 
 def _bench_child(payload: dict):
@@ -1493,6 +1534,210 @@ def bench_service_coalesced_suites(
     }
 
 
+def bench_service_elastic_placement(
+    num_rows: int = 1_000_000, clients: int = 4
+):
+    """Elastic device placement (docs/SERVICE.md "Elastic placement"):
+    K concurrent small suites — each on its OWN dataset key, so they
+    never coalesce — run twice through otherwise-identical services.
+    The ELASTIC arm uses the default policy (small footprints lease
+    1-device sub-slices, so runs overlap on disjoint devices); the
+    WHOLE-MESH arm pins every lease to the full pool, so runs
+    serialize on the lease. Both arms replay plans warmed beforehand
+    (the process-global shape-keyed plan cache), so the measured
+    recompiles-after-warmup must be 0; every run's metrics must be
+    bit-equal to the solo whole-mesh reference. The config needs a
+    multi-device pool — the parent injects
+    ``--xla_force_host_platform_device_count=8`` into the child's
+    environment (CONFIG_CHILD_ENV); a 1-device pool still returns
+    rc=0 with the degenerate numbers reported."""
+    import threading
+
+    import jax
+    import pyarrow as pa
+
+    from deequ_tpu import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.service import (
+        ElasticPlacer,
+        PlacementPolicy,
+        Priority,
+        RunRequest,
+        VerificationService,
+    )
+    from deequ_tpu.telemetry import get_telemetry
+
+    pool_total = jax.device_count()
+
+    def make():
+        # one seed for every tenant: identical data, so every run's
+        # metrics — elastic slice, whole-mesh slice, solo reference —
+        # must be BIT-equal, whatever the placement chose
+        rng = np.random.default_rng(11)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "k1": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "v1": rng.normal(0, 1, num_rows).astype(np.float32),
+                    "v2": rng.normal(0, 1, num_rows).astype(np.float32),
+                }
+            )
+        )
+
+    def suite():
+        return [
+            Check(CheckLevel.ERROR, "elastic-suite")
+            .is_complete("k1")
+            .is_non_negative("k1")
+            .is_complete("v1")
+        ]
+
+    def fingerprint(result):
+        # exact metric values (repr keeps every float bit) keyed by
+        # analyzer — the bit-equality pin across placements
+        return tuple(
+            sorted(
+                (str(analyzer), repr(getattr(metric, "value", metric)))
+                for analyzer, metric in dict(result.metrics).items()
+            )
+        )
+
+    whole_mesh_placer = lambda: ElasticPlacer(  # noqa: E731
+        policy=PlacementPolicy(
+            bytes_per_device=1, default_devices=pool_total
+        )
+    )
+
+    def run_phase(svc, label):
+        handles = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"tenant-{i}",
+                    checks=suite(),
+                    dataset_key=f"bench/elastic/{label}/{i}",
+                    dataset_factory=make,
+                    priority=Priority.BATCH,
+                )
+            )
+            for i in range(clients)
+        ]
+        t0 = time.time()
+        svc.start()
+        try:
+            threads = [
+                threading.Thread(target=h.wait, args=(600,))
+                for h in handles
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.time() - t0
+        finally:
+            svc.stop(drain=False, timeout=30)
+        waits = sorted(
+            max(0.0, (h.started_at or 0.0) - h.submitted_at)
+            for h in handles
+        )
+        spans = [
+            (
+                h.started_at or 0.0,
+                h.finished_at or 0.0,
+                (h.placement or {}).get("ndev") or pool_total,
+                tuple((h.placement or {}).get("device_ids") or ()),
+            )
+            for h in handles
+        ]
+        # peak placement concurrency: at each run start, how many runs
+        # were live at once — the leases guarantee their device sets
+        # are pairwise disjoint, which the artifact double-checks
+        max_live, disjoint = 0, True
+        for s0, _f0, _n0, _d0 in spans:
+            live = [
+                d
+                for s, f, _n, d in spans
+                if s <= s0 < f
+            ]
+            if len(live) > max_live:
+                max_live = len(live)
+                seen: set = set()
+                for dev_ids in live:
+                    if seen.intersection(dev_ids):
+                        disjoint = False
+                    seen.update(dev_ids)
+        busy = sum((f - s) * n for s, f, n, _d in spans)
+        return {
+            "wall_s": round(wall, 3),
+            "wait_p50_s": round(waits[len(waits) // 2], 4),
+            "wait_p99_s": round(waits[-1], 4),
+            "max_concurrent": max_live,
+            "slices_disjoint": disjoint,
+            "device_busy_fraction": round(
+                busy / (wall * pool_total), 4
+            )
+            if wall
+            else 0.0,
+            "placements": [
+                {"ndev": n, "device_ids": list(d)}
+                for _s, _f, n, d in spans
+            ],
+        }, [h.result(timeout=0) for h in handles]
+
+    tm = get_telemetry()
+
+    # solo whole-mesh reference: one run on the full pool — the
+    # bit-equality baseline; it also compiles the whole-mesh shape
+    solo_svc = VerificationService(
+        workers=1, isolated=False, coalesce=False,
+        placer=whole_mesh_placer(),
+    )
+    _stats, solo_results = run_phase(solo_svc, "solo")
+    solo_print = fingerprint(solo_results[0])
+
+    # warm the elastic shapes (untimed): same K submissions through an
+    # identical elastic service populate the process-global shape-keyed
+    # plan cache, so the measured arms below replay, never compile
+    warm_svc = VerificationService(
+        workers=clients, isolated=False, coalesce=False,
+        elastic_placement=True,
+    )
+    run_phase(warm_svc, "warm")
+
+    misses_before = tm.counter("engine.plan_cache.misses").value
+    elastic_svc = VerificationService(
+        workers=clients, isolated=False, coalesce=False,
+        elastic_placement=True,
+    )
+    elastic, elastic_results = run_phase(elastic_svc, "elastic")
+    whole_svc = VerificationService(
+        workers=clients, isolated=False, coalesce=False,
+        placer=whole_mesh_placer(),
+    )
+    whole, whole_results = run_phase(whole_svc, "whole")
+    recompiles = tm.counter("engine.plan_cache.misses").value - misses_before
+
+    bit_equal = all(
+        fingerprint(r) == solo_print
+        for r in elastic_results + whole_results
+    )
+    return {
+        "rows": num_rows,
+        "clients": clients,
+        "pool_devices": pool_total,
+        "elastic": elastic,
+        "whole_mesh": whole,
+        "recompiles_after_warmup": int(recompiles),
+        "metrics_bit_equal": bool(bit_equal),
+        "speedup": (
+            round(whole["wall_s"] / elastic["wall_s"], 3)
+            if elastic["wall_s"]
+            else 0.0
+        ),
+    }
+
+
 def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
     """BASELINE.json config 2 at its SPECIFIED scale, streamed:
     Mean/StdDev/Min/Max/Compliance over 10 numeric f32 columns,
@@ -1805,6 +2050,7 @@ def main(argv=None):
         status = {"rows": cfg_args.get("rows"), "estimated_s": est_s}
         t0 = time.time()
         payload = {"name": name, "args": cfg_args}
+        restore_env = _apply_child_env(name)
         try:
             if args.inline:
                 detail[name] = _bench_child(payload)
@@ -1840,6 +2086,8 @@ def main(argv=None):
             if rc is not None:
                 status["exitcode"] = rc
             detail.setdefault("errors", {})[name] = repr(exc)
+        finally:
+            restore_env()
         status["wall_s"] = round(time.time() - t0, 1)
         detail["config_status"][name] = status
         detail.setdefault("config_walls", {})[name] = status["wall_s"]
@@ -1930,6 +2178,12 @@ def main(argv=None):
             (
                 "service_coalesced_suites",
                 {"rows": 2_000_000, "clients": 4},
+                False,
+                120,
+            ),
+            (
+                "service_elastic_placement",
+                {"rows": 1_000_000, "clients": 4},
                 False,
                 120,
             ),

@@ -302,6 +302,35 @@ class Options:
     service_coalesce_max_members: int = int(
         os.environ.get("DEEQU_TPU_SERVICE_COALESCE_MAX_MEMBERS", 8) or 8
     )
+    # elastic device placement (service/placement.py, docs/SERVICE.md
+    # "Elastic placement"): bin-pack concurrent runs onto disjoint
+    # power-of-two mesh sub-slices instead of serializing whole-mesh.
+    # Opt-in like coalescing: default-off keeps today's host/whole-mesh
+    # engine construction untouched
+    service_elastic_placement: bool = (
+        os.environ.get("DEEQU_TPU_SERVICE_ELASTIC_PLACEMENT", "0") == "1"
+    )
+    # placement policy: one device per this many estimated run bytes
+    # (the admission watermark's estimate), rounded up to a power of two
+    service_placement_bytes_per_device: int = int(
+        os.environ.get(
+            "DEEQU_TPU_SERVICE_PLACEMENT_BYTES_PER_DEVICE", 512 << 20
+        )
+        or (512 << 20)
+    )
+    # ceiling on a single run's slice (0 = the whole pool)
+    service_placement_max_devices: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_PLACEMENT_MAX_DEVICES", 0) or 0
+    )
+    # slice size for runs with no byte estimate (factory datasets)
+    service_placement_default_devices: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_PLACEMENT_DEFAULT_DEVICES", 1)
+        or 1
+    )
+    # LRU cap on cached Mesh objects (one per distinct device slice)
+    service_placement_mesh_cache_slices: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_PLACEMENT_MESH_SLICES", 8) or 8
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp
@@ -354,6 +383,12 @@ def install_compilation_cache() -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # swap in the torn-write-safe store: atomic entry writes and
+        # validate-on-read, so a crash mid-put can never poison later
+        # runs with a truncated executable (docs/RESILIENCE.md)
+        from deequ_tpu.engine import compile_cache
+
+        compile_cache.install(cache_dir)
         _compile_cache_installed = True
     except Exception:  # cache is an optimization, never fatal
         pass

@@ -30,6 +30,13 @@ lint-enforced).
 
 from deequ_tpu.service.caches import DatasetCache, PlanCache
 from deequ_tpu.service.journal import RunJournal
+from deequ_tpu.service.placement import (
+    DevicePool,
+    ElasticPlacer,
+    MeshCache,
+    PlacementLease,
+    PlacementPolicy,
+)
 from deequ_tpu.service.queue import (
     Priority,
     QuotaExceeded,
@@ -47,6 +54,11 @@ from deequ_tpu.service.service import (
 
 __all__ = [
     "DatasetCache",
+    "DevicePool",
+    "ElasticPlacer",
+    "MeshCache",
+    "PlacementLease",
+    "PlacementPolicy",
     "PlanCache",
     "Priority",
     "QuotaExceeded",

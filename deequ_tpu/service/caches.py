@@ -158,6 +158,11 @@ class PlanCache:
         self._runs = 0
         self._warm_runs = 0
         self._recompile_runs = 0
+        # per placement shape (engine.plan_cache.per_shape.<label>.*
+        # counter deltas): label -> [hits, misses]. The elastic
+        # acceptance question is per-shape: "is EVERY slice size the
+        # policy can choose compile-free?"
+        self._per_shape: Dict[str, List[int]] = {}
 
     def note_warmed(self, tokens) -> None:
         tm = get_telemetry()
@@ -177,6 +182,17 @@ class PlanCache:
         counters = (summary or {}).get("counters", {}) or {}
         hits = int(counters.get("engine.plan_cache.hits", 0))
         misses = int(counters.get("engine.plan_cache.misses", 0))
+        prefix = "engine.plan_cache.per_shape."
+        shape_deltas: List[Tuple[str, int, int]] = []
+        for name, value in counters.items():
+            if not name.startswith(prefix):
+                continue
+            tail = name[len(prefix):]
+            label, _, kind = tail.rpartition(".")
+            if kind == "hits":
+                shape_deltas.append((label, int(value), 0))
+            elif kind == "misses":
+                shape_deltas.append((label, 0, int(value)))
         tm = get_telemetry()
         with self._lock:
             self._runs += 1
@@ -184,6 +200,10 @@ class PlanCache:
                 self._recompile_runs += 1
             elif hits:
                 self._warm_runs += 1
+            for label, h, m in shape_deltas:
+                cell = self._per_shape.setdefault(label, [0, 0])
+                cell[0] += h
+                cell[1] += m
         if misses:
             tm.counter("service.plan_cache.recompiles").inc(misses)
         if hits:
@@ -204,4 +224,8 @@ class PlanCache:
                 "warm_runs": self._warm_runs,
                 "recompile_runs": self._recompile_runs,
                 "engine_resident_plans": len(plan_cache_snapshot()),
+                "per_shape": {
+                    label: {"hits": cell[0], "misses": cell[1]}
+                    for label, cell in sorted(self._per_shape.items())
+                },
             }

@@ -1,0 +1,412 @@
+"""Elastic device placement: bin-pack concurrent runs onto mesh
+sub-slices (docs/SERVICE.md "Elastic placement").
+
+The coalescer (service/coalesce.py) fuses COMPATIBLE runs into one
+superset scan; any two runs that cannot coalesce still serialized on
+the whole device mesh — a fleet of small interactive suites left most
+chips idle while one large run monopolized all of them. This module
+packs concurrent runs onto DISJOINT device sub-slices instead:
+
+- :class:`DevicePool` — tracks which devices are free. Slices are
+  power-of-two sized and buddy-ALIGNED (a k-device slice starts at an
+  offset divisible by k), so released slices re-merge into larger free
+  blocks instead of fragmenting the pool: two 1-device runs can never
+  straddle an aligned 2-device block and starve a 2-device run that
+  would otherwise fit.
+- :class:`PlacementPolicy` — picks the slice size (1/2/4/8...) for a
+  run from its estimated device footprint
+  (``engine.scan.estimated_run_bytes``, the same coarse estimate the
+  admission watermark gates on): ``ceil(estimated_bytes /
+  bytes_per_device)`` rounded up to a power of two, clamped to the
+  pool. Runs with no estimate get ``default_devices``.
+- :class:`MeshCache` — LRU of ``jax.sharding.Mesh`` objects per chosen
+  device subset. Reusing the SAME ``Mesh`` object for the same slice
+  keeps jit signatures equal across runs, so a warmed per-shape plan
+  (engine/scan.py ``_placement_shape``) re-executes with zero traces.
+- :class:`ElasticPlacer` — the facade the scheduler drives: ``place()``
+  blocks until a slice frees up (lease wait counts as queue wait — the
+  handle's ``started_at`` is stamped AFTER placement, and the run's
+  deadline budget burns while it waits, mirroring the admission
+  controller's queued-run semantics), returns a
+  :class:`PlacementLease`; ``release()`` returns the slice to the pool.
+
+Per-token shape affinity: once a structural hint (dataset key + plan
+surface) has run on a slice shape, later runs with the same hint
+prefer that shape — the per-shape plan cache already holds their
+compiled program, so a pool-pressure-driven resize never eats a fresh
+compile in steady state.
+
+Thread discipline: this module runs on the service's INJECTED clock
+(``MonotonicClock``/``ManualClock``) only, constructs no threads, and
+never references the engine's scan entry points — the lease carries a
+``Mesh``; the service's executor hands it to ``AnalysisEngine`` and
+still enters the engine through the runner's admission layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.engine.deadline import (
+    DeadlineExceeded,
+    MonotonicClock,
+    RunCancelled,
+)
+from deequ_tpu.telemetry import get_telemetry
+
+#: service.placement_wait_s histogram buckets — same shape as the
+#: scheduler's queue-wait buckets (lease wait IS queue wait)
+PLACEMENT_WAIT_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
+
+
+def _floor_pow2(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _ceil_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class PlacementLease:
+    """One granted device slice: the concrete devices, their pool
+    offset, and the (LRU-cached) ``Mesh`` built over them. Owned by the
+    scheduler for the run's duration; ``ElasticPlacer.release`` is the
+    only way back to the pool."""
+
+    devices: Tuple[Any, ...]
+    start: int
+    ndev: int
+    mesh: Any
+    wait_s: float = 0.0
+    released: bool = False
+
+    @property
+    def device_ids(self) -> List[int]:
+        return [
+            int(getattr(d, "id", i)) for i, d in enumerate(self.devices)
+        ]
+
+
+class DevicePool:
+    """Free-set tracker over an ordered device list with buddy-aligned
+    power-of-two slice allocation.
+
+    ``acquire`` blocks until an aligned run of ``ndev`` free devices
+    exists, polling at the injected clock's cadence so a waiting run's
+    own deadline budget (possibly on a fake clock) and cancel tokens
+    stay live — the same contract as
+    :class:`~deequ_tpu.engine.deadline.AdmissionController`. A lease
+    that cannot be granted before EVERY live budget expires raises
+    :class:`DeadlineExceeded` (a run that cannot start in time must not
+    start); one whose every cancel token fired raises
+    :class:`RunCancelled`."""
+
+    def __init__(self, devices: Optional[Sequence[Any]] = None, clock=None):
+        if devices is None:
+            import jax
+
+            devices = list(jax.devices())
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        self._devices: List[Any] = list(devices)
+        self._busy = [False] * len(self._devices)
+        self._cond = threading.Condition()
+        self._clock = clock or MonotonicClock()
+
+    @property
+    def total(self) -> int:
+        return len(self._devices)
+
+    @property
+    def max_slice(self) -> int:
+        """Largest grantable slice (the pool's floor power of two)."""
+        return _floor_pow2(len(self._devices))
+
+    def free_count(self) -> int:
+        with self._cond:
+            return sum(1 for b in self._busy if not b)
+
+    def busy_map(self) -> List[bool]:
+        with self._cond:
+            return list(self._busy)
+
+    def _find_slot_locked(self, ndev: int) -> Optional[int]:
+        n = len(self._busy)
+        for start in range(0, n - ndev + 1, ndev):  # buddy alignment
+            if not any(self._busy[start:start + ndev]):
+                return start
+        return None
+
+    def try_acquire(self, ndev: int) -> Optional[Tuple[int, Tuple[Any, ...]]]:
+        """Non-blocking grant of an aligned ``ndev`` slice, or None."""
+        ndev = self._clamp(ndev)
+        with self._cond:
+            start = self._find_slot_locked(ndev)
+            if start is None:
+                return None
+            for i in range(start, start + ndev):
+                self._busy[i] = True
+            return start, tuple(self._devices[start:start + ndev])
+
+    def _clamp(self, ndev: int) -> int:
+        return max(1, min(_ceil_pow2(max(1, int(ndev))), self.max_slice))
+
+    def acquire(
+        self,
+        ndev: int,
+        budgets: Sequence[Any] = (),
+        cancels: Sequence[Any] = (),
+    ) -> Tuple[int, Tuple[Any, ...]]:
+        """Block until an aligned ``ndev`` slice frees up. Returns
+        ``(start, devices)``. Deadline/cancel semantics documented on
+        the class."""
+        ndev = self._clamp(ndev)
+        live_budgets = [b for b in budgets if b is not None]
+        live_cancels = [c for c in cancels if c is not None]
+        for budget in live_budgets:
+            budget.start()  # idempotent: already started at submit
+        with self._cond:
+            while True:
+                start = self._find_slot_locked(ndev)
+                if start is not None:
+                    for i in range(start, start + ndev):
+                        self._busy[i] = True
+                    return start, tuple(
+                        self._devices[start:start + ndev]
+                    )
+                # a group shares one lease wait: interrupt only once
+                # EVERY member's envelope is closed, so the surviving
+                # members still get their (possibly partial) results
+                if live_cancels and all(
+                    c.cancelled for c in live_cancels
+                ):
+                    raise RunCancelled(
+                        "cancelled while waiting for a device slice"
+                    )
+                if live_budgets and all(
+                    b.expired() for b in live_budgets
+                ):
+                    raise DeadlineExceeded(
+                        "waited for a device slice past the run "
+                        "deadline"
+                    )
+                self._cond.wait(timeout=self._clock.queue_poll_s())
+
+    def release(self, start: int, ndev: int) -> None:
+        with self._cond:
+            for i in range(start, start + ndev):
+                self._busy[i] = False
+            self._cond.notify_all()
+
+
+@dataclass(frozen=True)
+class PlacementPolicy:
+    """Slice-size policy: one device per ``bytes_per_device`` of the
+    run's estimated footprint, rounded UP to a power of two, clamped to
+    ``[1, min(max_devices, pool)]``. Runs with no estimate (factory
+    datasets whose size is unknown at submit) get ``default_devices``.
+    The policy table lives in docs/SERVICE.md "Elastic placement"."""
+
+    bytes_per_device: int = 512 << 20
+    max_devices: int = 0  # 0 = the whole pool
+    default_devices: int = 1
+
+    def slice_size(self, estimated_bytes: int, pool_max: int) -> int:
+        cap = pool_max
+        if self.max_devices > 0:
+            cap = min(cap, _floor_pow2(self.max_devices))
+        cap = max(1, cap)
+        if estimated_bytes <= 0:
+            want = max(1, int(self.default_devices))
+        else:
+            per = max(1, int(self.bytes_per_device))
+            want = -(-int(estimated_bytes) // per)
+        return max(1, min(_ceil_pow2(want), cap))
+
+
+class MeshCache:
+    """LRU of ``jax.sharding.Mesh`` objects keyed by the device-id
+    tuple of the slice. Object identity matters beyond the build cost:
+    handing runs the SAME ``Mesh`` for the same slice keeps their input
+    shardings equal, so jit serves the cached executable instead of
+    re-tracing (the per-shape warm contract)."""
+
+    def __init__(self, cap: int = 8, axis: str = "dp"):
+        self.cap = max(1, int(cap))
+        self.axis = axis
+        self._lock = threading.Lock()
+        self._meshes: "OrderedDict[tuple, Any]" = OrderedDict()
+
+    def mesh_for(self, devices: Sequence[Any]):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        key = tuple(
+            int(getattr(d, "id", i)) for i, d in enumerate(devices)
+        )
+        with self._lock:
+            mesh = self._meshes.get(key)
+            if mesh is not None:
+                self._meshes.move_to_end(key)
+                return mesh
+        mesh = Mesh(np.array(list(devices)), (self.axis,))
+        with self._lock:
+            existing = self._meshes.get(key)
+            if existing is not None:
+                self._meshes.move_to_end(key)
+                return existing
+            self._meshes[key] = mesh
+            while len(self._meshes) > self.cap:
+                self._meshes.popitem(last=False)
+        return mesh
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._meshes)
+
+
+class ElasticPlacer:
+    """Pool + policy + mesh cache behind one ``place``/``release``
+    pair. Telemetry: ``service.placements`` counter,
+    ``service.placement_wait_s`` histogram, ``service.slices_active``
+    gauge, and one ``run_placed`` event per placed run (run id, slice
+    size, device ids, lease wait)."""
+
+    def __init__(
+        self,
+        pool: Optional[DevicePool] = None,
+        policy: Optional[PlacementPolicy] = None,
+        clock=None,
+        mesh_cache_slices: Optional[int] = None,
+    ):
+        from deequ_tpu import config
+
+        opts = config.options()
+        self.clock = clock or MonotonicClock()
+        self.pool = pool or DevicePool(clock=self.clock)
+        self.policy = policy or PlacementPolicy(
+            bytes_per_device=opts.service_placement_bytes_per_device,
+            max_devices=opts.service_placement_max_devices,
+            default_devices=opts.service_placement_default_devices,
+        )
+        self.meshes = MeshCache(
+            cap=(
+                opts.service_placement_mesh_cache_slices
+                if mesh_cache_slices is None
+                else mesh_cache_slices
+            )
+        )
+        self._lock = threading.Lock()
+        self._active_slices = 0
+        # structural hint -> slice shape last granted for it (the
+        # per-shape plan cache already holds that shape's program)
+        self._shape_affinity: Dict[Any, int] = {}
+
+    # -- sizing ---------------------------------------------------------
+
+    def slice_for(
+        self, estimated_bytes: int, hint: Any = None
+    ) -> int:
+        with self._lock:
+            preferred = (
+                self._shape_affinity.get(hint) if hint is not None else None
+            )
+        if preferred is not None:
+            return min(preferred, self.pool.max_slice)
+        return self.policy.slice_size(
+            estimated_bytes, self.pool.max_slice
+        )
+
+    # -- lease lifecycle -------------------------------------------------
+
+    def place(
+        self,
+        estimated_bytes: int = 0,
+        hint: Any = None,
+        run_ids: Sequence[str] = (),
+        budgets: Sequence[Any] = (),
+        cancels: Sequence[Any] = (),
+    ) -> PlacementLease:
+        """Grant a slice for one run (or one coalesced group — the
+        whole group shares a single lease). Blocks until the pool can
+        serve it; the wait shows up in the run's queue-wait histogram
+        because ``started_at`` is stamped after placement."""
+        tm = get_telemetry()
+        ndev = self.slice_for(estimated_bytes, hint=hint)
+        t0 = self.clock.now()
+        start, devices = self.pool.acquire(
+            ndev, budgets=budgets, cancels=cancels
+        )
+        wait_s = max(0.0, self.clock.now() - t0)
+        mesh = self.meshes.mesh_for(devices)
+        lease = PlacementLease(
+            devices=devices,
+            start=start,
+            ndev=len(devices),
+            mesh=mesh,
+            wait_s=wait_s,
+        )
+        with self._lock:
+            if hint is not None:
+                self._shape_affinity[hint] = lease.ndev
+                # bounded: affinity is a hot-set memo, not a registry
+                while len(self._shape_affinity) > 256:
+                    self._shape_affinity.pop(
+                        next(iter(self._shape_affinity))
+                    )
+            self._active_slices += 1
+            active = self._active_slices
+        tm.counter("service.placements").inc()
+        tm.metrics.histogram(
+            "service.placement_wait_s", buckets=PLACEMENT_WAIT_BUCKETS
+        ).observe(wait_s)
+        tm.metrics.gauge("service.slices_active").set(active)
+        for run_id in run_ids or ("?",):
+            tm.event(
+                "run_placed",
+                run_id=run_id,
+                ndev=lease.ndev,
+                device_ids=",".join(str(i) for i in lease.device_ids),
+                lease_wait_s=round(wait_s, 6),
+            )
+        return lease
+
+    def release(self, lease: PlacementLease) -> None:
+        with self._lock:
+            if lease.released:
+                return
+            lease.released = True
+            self._active_slices = max(0, self._active_slices - 1)
+            active = self._active_slices
+        self.pool.release(lease.start, lease.ndev)
+        get_telemetry().metrics.gauge("service.slices_active").set(
+            active
+        )
+
+    # -- introspection ---------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            active = self._active_slices
+            affinity = dict(self._shape_affinity)
+        return {
+            "pool_total": self.pool.total,
+            "pool_free": self.pool.free_count(),
+            "active_slices": active,
+            "cached_meshes": len(self.meshes),
+            "shape_affinity": {
+                str(k): v for k, v in affinity.items()
+            },
+        }

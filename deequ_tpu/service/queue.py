@@ -96,6 +96,9 @@ class RunHandle:
         self.submitted_at: Optional[float] = None
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # elastic placement record, filled by the scheduler when a
+        # placer is wired: {"ndev", "device_ids", "lease_wait_s"}
+        self.placement: Optional[dict] = None
         # fired exactly once, after the terminal transition publishes
         # (the service's journal hook rides here, so EVERY terminal
         # path — scheduler finish, queued-state rejection, drain —
@@ -204,6 +207,10 @@ class RunTicket:
     # tickets with EQUAL surfaces, so a config change between two
     # submissions can't smuggle differently-planned runs into one scan
     coalesce_surface: Optional[tuple] = None
+    # placement lease granted by the scheduler's ElasticPlacer just
+    # before execution (service/placement.py); None when elastic
+    # placement is off. A coalesced group shares ONE lease object.
+    lease: Optional[Any] = None
 
     @property
     def sort_key(self):

@@ -53,6 +53,7 @@ class Scheduler:
             Callable[[List[RunTicket]], List[Any]]
         ] = None,
         coalesce: Optional[Any] = None,
+        placer: Optional[Any] = None,
     ):
         self.queue = queue
         self.execute = execute
@@ -62,6 +63,11 @@ class Scheduler:
         # Without it, groups never form (the policy is ignored).
         self.execute_group = execute_group
         self.coalesce = coalesce if execute_group is not None else None
+        # elastic placement (service/placement.py): when wired, a
+        # worker leases a device slice for its group BEFORE marking the
+        # runs started — lease wait lands inside queue_wait_s and burns
+        # the members' budgets, exactly like admission-queue wait
+        self.placer = placer
         self.workers = max(1, int(workers))
         # at least one general worker must remain or BATCH/STANDARD
         # work could never run at all
@@ -188,6 +194,32 @@ class Scheduler:
         else:
             self._finish_result(ticket, outcome)
 
+    def _place_group(self, group: List[RunTicket]) -> Any:
+        """Lease ONE device slice for the whole group (coalesced
+        members run in one superset scan over the same dataset, so the
+        largest member's footprint sizes the slice). Blocks until the
+        pool can serve it; every member's budget keeps burning and any
+        member's cancel stays live while waiting."""
+        estimated = max(
+            (ticket.estimated_bytes or 0) for ticket in group
+        )
+        lead = group[0]
+        lease = self.placer.place(
+            estimated_bytes=estimated,
+            hint=(lead.dataset_key, lead.coalesce_surface),
+            run_ids=[t.handle.run_id for t in group],
+            budgets=[t.budget for t in group],
+            cancels=[t.handle.cancel_token for t in group],
+        )
+        for ticket in group:
+            ticket.lease = lease
+            ticket.handle.placement = {
+                "ndev": lease.ndev,
+                "device_ids": lease.device_ids,
+                "lease_wait_s": lease.wait_s,
+            }
+        return lease
+
     # -- the worker loop ------------------------------------------------
 
     def _worker_loop(self, max_priority: Optional[int]) -> None:
@@ -199,6 +231,19 @@ class Scheduler:
             )
             if group is None:
                 return  # queue closed or scheduler stopping
+            lease = None
+            if self.placer is not None:
+                try:
+                    lease = self._place_group(group)
+                # lint-ok: interrupt-swallow: same contract as the
+                # execute path below — a lease the group could not get
+                # in time (DeadlineExceeded/RunCancelled) terminates
+                # the members through their handles, not the worker
+                except BaseException as exc:  # noqa: BLE001
+                    for ticket in group:
+                        self._finish_failed(ticket, exc)
+                        self.queue.task_done(ticket)
+                    continue
             for ticket in group:
                 self._mark_started(ticket, len(group))
             try:
@@ -222,5 +267,7 @@ class Scheduler:
                 for ticket, outcome in zip(group, outcomes):
                     self._finish_outcome(ticket, outcome)
             finally:
+                if lease is not None:
+                    self.placer.release(lease)
                 for ticket in group:
                     self.queue.task_done(ticket)

@@ -88,6 +88,11 @@ class RunRequest:
     #: coalesce (the artifact is per-run) and always execute in-process
     #: (the writer's file handles cannot cross a spawn boundary).
     row_level_sink: Any = None
+    #: explicit device-footprint estimate (bytes) for the elastic
+    #: placement policy; None = derive from ``dataset`` at admit when
+    #: one was passed (factory-only submissions place at the policy's
+    #: default slice unless this is set)
+    estimated_bytes: Optional[int] = None
 
     def __post_init__(self):
         if self.dataset is not None and self.dataset_factory is None:
@@ -135,6 +140,8 @@ class VerificationService:
         execute_group: Optional[
             Callable[[List[RunTicket]], List[Any]]
         ] = None,
+        elastic_placement: Optional[bool] = None,
+        placer: Optional[Any] = None,
     ):
         from deequ_tpu import config
 
@@ -221,6 +228,19 @@ class VerificationService:
                     else coalesce_max_members
                 ),
             )
+        # elastic device placement (docs/SERVICE.md "Elastic
+        # placement"): opt-in like coalescing; an injected placer wins
+        # over the flag (fake-pool tests)
+        elastic_on = bool(
+            opts.service_elastic_placement
+            if elastic_placement is None
+            else elastic_placement
+        )
+        self.placer = placer
+        if self.placer is None and elastic_on:
+            from deequ_tpu.service.placement import ElasticPlacer
+
+            self.placer = ElasticPlacer(clock=self.clock)
         self.scheduler = Scheduler(
             self.queue,
             execute if execute is not None else self._execute,
@@ -235,6 +255,7 @@ class VerificationService:
             clock=self.clock,
             execute_group=execute_group,
             coalesce=self.coalesce_policy,
+            placer=self.placer,
         )
         self._run_seq = 0
         self._handles: Dict[str, RunHandle] = {}
@@ -361,11 +382,25 @@ class VerificationService:
             from deequ_tpu.engine.scan import coalesce_key_surface
 
             surface = coalesce_key_surface()
+        estimated = 0
+        if request.estimated_bytes is not None:
+            estimated = max(0, int(request.estimated_bytes))
+        elif self.placer is not None and request.dataset is not None:
+            # submit-time footprint for the placement policy — the SAME
+            # coarse estimate the admission watermark gates on (module
+            # function: the service never builds an engine here)
+            from deequ_tpu.engine.scan import estimated_run_bytes
+
+            try:
+                estimated = int(estimated_run_bytes(request.dataset))
+            except Exception:  # noqa: BLE001 — estimate is advisory
+                estimated = 0
         ticket = RunTicket(
             seq=0,  # assigned by the queue
             handle=handle,
             payload=request,
             budget=budget,
+            estimated_bytes=estimated,
             dataset_key=request.dataset_key,
             coalesce_surface=surface,
         )
@@ -563,6 +598,15 @@ class VerificationService:
         with zero recompiles (the acceptance telemetry in
         examples/verification_service.py)."""
         warm_plans = _load_warm_plans()
+        if self.placer is not None and "mesh_shapes" not in kwargs:
+            # elastic placement: warm EVERY slice shape the policy can
+            # choose, so a pool-pressure-driven resize never compiles
+            shapes: List[int] = []
+            ndev = 1
+            while ndev <= self.placer.pool.max_slice:
+                shapes.append(ndev)
+                ndev *= 2
+            kwargs["mesh_shapes"] = shapes
         report = warm_plans(
             schema, suite=suite, nullable=nullable, **kwargs
         )
@@ -570,6 +614,26 @@ class VerificationService:
         return list(report.get("tokens", []))
 
     # -- the real executor ----------------------------------------------
+
+    def _build_engine(self, lease: Any, run_id: str):
+        """The per-run ``AnalysisEngine``, or None when neither a
+        durable checkpoint path nor a placement lease calls for one.
+        A leased run executes on its slice's mesh — the engine's
+        placement feeds the shape-keyed plan cache, so every slice of
+        the same size replays the warmed plan."""
+        mesh = getattr(lease, "mesh", None) if lease is not None else None
+        if self._checkpoint_path is None and mesh is None:
+            return None
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        kwargs: Dict[str, Any] = {}
+        if self._checkpoint_path is not None:
+            kwargs["checkpointer"] = _JournalingCheckpointer(
+                self._checkpoint_path, self.journal, run_id
+            )
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        return AnalysisEngine(**kwargs)
 
     def _execute(self, ticket: RunTicket):
         request: RunRequest = ticket.payload
@@ -610,17 +674,9 @@ class VerificationService:
             dataset_key=request.dataset_key,
             cache_hit=hit,
         )
-        engine = None
-        if self._checkpoint_path is not None:
-            from deequ_tpu.engine.scan import AnalysisEngine
-
-            engine = AnalysisEngine(
-                checkpointer=_JournalingCheckpointer(
-                    self._checkpoint_path,
-                    self.journal,
-                    ticket.handle.run_id,
-                )
-            )
+        engine = self._build_engine(
+            ticket.lease, run_id=ticket.handle.run_id
+        )
         try:
             result = VerificationSuite.do_verification_run(
                 dataset,
@@ -666,6 +722,13 @@ class VerificationService:
                 ticket.budget.remaining()
                 if ticket.budget is not None
                 else None
+            ),
+            # slice SIZE crosses the boundary, not the lease: the child
+            # owns its own jax runtime, so it rebuilds an ndev mesh
+            # over its own first local devices (the lease still bounds
+            # parent-side concurrency for the run's duration)
+            "placement_ndev": (
+                ticket.lease.ndev if ticket.lease is not None else None
             ),
         }
         try:
@@ -773,17 +836,9 @@ class VerificationService:
             cache_hit=hit,
             coalesced_members=len(tickets),
         )
-        engine = None
-        if self._checkpoint_path is not None:
-            from deequ_tpu.engine.scan import AnalysisEngine
-
-            engine = AnalysisEngine(
-                checkpointer=_JournalingCheckpointer(
-                    self._checkpoint_path,
-                    self.journal,
-                    host.handle.run_id,
-                )
-            )
+        engine = self._build_engine(
+            host.lease, run_id=host.handle.run_id
+        )
         try:
             # the superset scan runs under the HOST's envelope (best
             # priority, earliest seq). Member deadlines governed queue
@@ -868,6 +923,11 @@ class VerificationService:
                 if tickets[0].budget is not None
                 else None
             ),
+            "placement_ndev": (
+                tickets[0].lease.ndev
+                if tickets[0].lease is not None
+                else None
+            ),
         }
         try:
             pickle.dumps(payload)
@@ -933,11 +993,14 @@ class VerificationService:
     # -- introspection --------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "queue": self.queue.snapshot(),
             "datasets": self.datasets.snapshot(),
             "plans": self.plans.snapshot(),
         }
+        if self.placer is not None:
+            snap["placement"] = self.placer.snapshot()
+        return snap
 
 
 class _JournalingCheckpointer(ScanCheckpointer):
@@ -968,6 +1031,36 @@ class _JournalingCheckpointer(ScanCheckpointer):
             )
 
 
+def _child_engine(payload: Dict[str, Any]):
+    """Rebuild the child-side ``AnalysisEngine`` from a spawn payload:
+    a checkpointer over the durable path when journaling, and — for a
+    leased run — a mesh over the child's own first ``placement_ndev``
+    local devices (a lease object cannot cross a spawn boundary; the
+    SIZE reproduces the parent's placement shape, so the child hits the
+    same shape-keyed plan entry its warmup compiled)."""
+    kwargs: Dict[str, Any] = {}
+    if payload.get("checkpoint_path"):
+        kwargs["checkpointer"] = ScanCheckpointer(
+            payload["checkpoint_path"]
+        )
+    ndev = payload.get("placement_ndev")
+    if ndev:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) >= int(ndev):
+            kwargs["mesh"] = Mesh(
+                np.array(devices[: int(ndev)]), ("dp",)
+            )
+    if not kwargs:
+        return None
+    from deequ_tpu.engine.scan import AnalysisEngine
+
+    return AnalysisEngine(**kwargs)
+
+
 def _isolated_execute(payload: Dict[str, Any]):
     """Child-process entry for one isolated verification run (module
     level: spawn pickles it by reference). Rebuilds the dataset from
@@ -977,13 +1070,7 @@ def _isolated_execute(payload: Dict[str, Any]):
     pipe; row-level export needs an in-process run)."""
     from deequ_tpu.verification.suite import VerificationSuite
 
-    engine = None
-    if payload.get("checkpoint_path"):
-        from deequ_tpu.engine.scan import AnalysisEngine
-
-        engine = AnalysisEngine(
-            checkpointer=ScanCheckpointer(payload["checkpoint_path"])
-        )
+    engine = _child_engine(payload)
     dataset = payload["dataset_factory"]()
     result = VerificationSuite.do_verification_run(
         dataset,
@@ -1004,13 +1091,7 @@ def _isolated_execute_coalesced(payload: Dict[str, Any]) -> List[Any]:
     not cross the pipe)."""
     from deequ_tpu.verification.suite import VerificationSuite
 
-    engine = None
-    if payload.get("checkpoint_path"):
-        from deequ_tpu.engine.scan import AnalysisEngine
-
-        engine = AnalysisEngine(
-            checkpointer=ScanCheckpointer(payload["checkpoint_path"])
-        )
+    engine = _child_engine(payload)
     dataset = payload["dataset_factory"]()
     results = VerificationSuite.do_coalesced_verification_run(
         dataset,

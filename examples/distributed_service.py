@@ -1,0 +1,308 @@
+"""A 2-process distributed verification service over loopback: the
+elastic-placement service driving the process-sharded global-array
+feed (docs/SERVICE.md "Elastic placement" + docs/MULTIHOST.md).
+
+Two real processes (4 virtual CPU devices each) initialize
+``jax.distributed`` against a loopback coordinator. EACH process runs
+an identical ``VerificationService`` replica — one worker, the same
+submissions made before ``start()`` — so the run order is
+deterministic and both processes execute the same collective scans in
+the same order: the standard multi-controller SPMD discipline.
+Process 0's queue IS the fleet's run queue; its peer merely mirrors
+it. Every run leases the full 8-device global mesh from the elastic
+placer, and the streaming scan's process-sharded ingest
+(``engine/ingest.process_sharded_feed``) means each process reads
+ONLY its own parquet row-group shard and contributes its local rows
+to ONE global array per batch leaf via
+``jax.make_array_from_process_local_data`` — no host ever sees the
+other's rows.
+
+The parent then recomputes the same suite over the WHOLE table in a
+single process and asserts the fleet's metrics match.
+
+    python examples/distributed_service.py
+
+NOTE: like examples/multihost_grouping.py, the cross-process
+collective scan needs a real multi-host backend; under
+``JAX_PLATFORMS=cpu`` the CPU backend has no cross-host collective
+transport, so tests/test_multihost.py carries this as a backend-keyed
+xfail (it runs for real on a multi-host TPU slice).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_ROWS = 400_000
+N_SUITES = 3
+
+# the suite every tenant submits — shared source so the parent's
+# whole-table reference run builds EXACTLY the same checks
+SUITE_SRC = """
+def make_suite(i):
+    from deequ_tpu import Check, CheckLevel
+
+    return [
+        Check(CheckLevel.ERROR, f"fleet-suite-{i}")
+        .is_complete("k1")
+        .is_non_negative("k1")
+        .is_complete("v1")
+    ]
+"""
+
+WORKER = r"""
+import json, sys
+coordinator, pid, data_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+import os
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+
+from deequ_tpu import Dataset
+from deequ_tpu.service import (
+    DevicePool,
+    ElasticPlacer,
+    PlacementPolicy,
+    Priority,
+    RunRequest,
+    VerificationService,
+)
+
+_SUITE_SRC
+
+ndev = len(jax.devices())  # 8 global devices, 4 addressable per host
+
+# identical service replica on every process: ONE worker and all
+# submissions made before start() make the pop order deterministic
+# FIFO, so both replicas execute the same collective scans in the
+# same order (multi-controller SPMD: replicate the controller, never
+# fork it). The placer's policy pins every lease to the full global
+# pool — the whole-mesh placement the sharded feed needs.
+placer = ElasticPlacer(
+    pool=DevicePool(jax.devices()),
+    policy=PlacementPolicy(bytes_per_device=1, default_devices=ndev),
+)
+svc = VerificationService(
+    workers=1, isolated=False, coalesce=False, placer=placer
+)
+handles = [
+    svc.submit(
+        RunRequest(
+            tenant=f"tenant-{i}",
+            checks=make_suite(i),
+            dataset_key="fleet/shared-table",
+            dataset_factory=lambda: Dataset.from_parquet(data_path),
+            priority=Priority.BATCH,
+        )
+    )
+    for i in range(N_SUITES)
+]
+svc.start()
+try:
+    results = [h.result(timeout=300) for h in handles]
+finally:
+    svc.stop(drain=False, timeout=30)
+
+def _metric_value(m):
+    try:
+        return m.value.get()
+    except Exception:  # noqa: BLE001 — a failed metric reports as text
+        return str(getattr(m, "value", m))
+
+out = {"placements": [], "runs": []}
+for h, r in zip(handles, results):
+    out["placements"].append(dict(h.placement or {}))
+    out["runs"].append(
+        {
+            "status": str(r.status),
+            "degradation": str(getattr(r, "degradation", None)),
+            "metrics": {
+                str(a): _metric_value(m)
+                for a, m in dict(r.metrics).items()
+            },
+        }
+    )
+if pid == 0:
+    print("SERVICE_METRICS " + json.dumps(out, default=str), flush=True)
+print(f"worker {pid} done", flush=True)
+""".replace("_SUITE_SRC", SUITE_SRC).replace("N_SUITES", str(N_SUITES))
+
+
+def main() -> None:
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_dist_svc_")
+    try:
+        _run(workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _make_table():
+    import numpy as np
+    import pyarrow as pa
+
+    rng = np.random.default_rng(17)
+    k1 = rng.integers(0, 1 << 30, N_ROWS, dtype=np.int64)
+    v1 = rng.normal(0, 1, N_ROWS).astype(np.float32).astype(object)
+    v1[::13] = None  # completeness must see real nulls
+    return pa.table(
+        {"k1": k1, "v1": pa.array(list(v1), pa.float32())}
+    )
+
+
+def _run(workdir: str) -> None:
+    import pyarrow.parquet as pq
+
+    table = _make_table()
+    # UNEQUAL multi-file shards so the row-group shard planner has
+    # real work: each process's shard_view gets its own file(s)
+    data_dir = os.path.join(workdir, "table")
+    os.makedirs(data_dir, exist_ok=True)
+    split = int(N_ROWS * 0.6)
+    pq.write_table(
+        table.slice(0, split), os.path.join(data_dir, "part0.parquet")
+    )
+    pq.write_table(
+        table.slice(split), os.path.join(data_dir, "part1.parquet")
+    )
+
+    with socket.socket() as s:  # free loopback port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, coordinator, str(i), data_dir],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    # shared deadline: when one worker dies its sibling hangs in the
+    # collectives — kill it and report the real failure's output
+    import time as _time
+
+    deadline = _time.monotonic() + 600
+    outputs = [b"", b""]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outputs[i], _ = p.communicate(
+                    timeout=max(1.0, deadline - _time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                pass  # judged below after every worker is reaped
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for i, p in enumerate(procs):
+            if p.poll() is None or not outputs[i]:
+                try:
+                    extra, _ = p.communicate(timeout=10)
+                    outputs[i] = outputs[i] + (extra or b"")
+                except Exception:  # noqa: BLE001 — reporting only
+                    pass
+    failed = [i for i, p in enumerate(procs) if p.returncode != 0]
+    if failed:
+        report = "\n".join(
+            f"--- worker {i} (rc={procs[i].returncode}) ---\n"
+            + outputs[i].decode(errors="replace")
+            for i in range(2)
+        )
+        raise RuntimeError(f"worker(s) {failed} failed:\n{report}")
+
+    got = None
+    for line in outputs[0].decode().splitlines():
+        if line.startswith("SERVICE_METRICS "):
+            got = json.loads(line[len("SERVICE_METRICS "):])
+    assert got is not None, outputs[0].decode()
+
+    # every run leased the FULL global mesh (the sharded feed's shape)
+    assert len(got["placements"]) == N_SUITES, got["placements"]
+    for placement in got["placements"]:
+        assert placement.get("ndev") == 8, placement
+
+    # backend gate: on a CPU backend the cross-process collective scan
+    # cannot execute — the resilience layer quarantines every batch
+    # UNIFORMLY on both hosts (no one-sided hang; the placement, run
+    # queue and sharded feed all worked) and each run degrades to an
+    # empty-state ERROR. Raise the real reason so the test's
+    # backend-keyed xfail reads it; runs for real on a multi-host TPU
+    # slice (ROADMAP item 5).
+    backend_wall = [
+        run
+        for run in got["runs"]
+        if "Multiprocess computations aren't implemented"
+        in run.get("degradation", "")
+    ]
+    if backend_wall:
+        raise RuntimeError(
+            "cross-process collective scan unavailable on this backend "
+            "(CPU has no multi-process computations); fleet placement/"
+            "queue/sharded-feed all executed and quarantined uniformly "
+            f"— degradation: {backend_wall[0]['degradation']}"
+        )
+
+    # whole-table single-process reference: same suites, same data
+    from deequ_tpu import Dataset
+    from deequ_tpu.verification import VerificationSuite
+
+    exec(SUITE_SRC, globals())
+    whole = Dataset.from_arrow(table)
+    for i, run in enumerate(got["runs"]):
+        solo = VerificationSuite.do_verification_run(
+            whole, make_suite(i)  # noqa: F821 — bound by exec above
+        )
+        def _metric_value(m):
+            try:
+                return m.value.get()
+            except Exception:  # noqa: BLE001 — failed metric -> text
+                return str(getattr(m, "value", m))
+
+        want = {
+            str(a): _metric_value(m)
+            for a, m in dict(solo.metrics).items()
+        }
+        assert set(run["metrics"]) == set(want), (
+            set(run["metrics"]) ^ set(want)
+        )
+        for name, have in run["metrics"].items():
+            w = want[name]
+            try:
+                have_f, want_f = float(have), float(w)
+            except (TypeError, ValueError):
+                assert str(have) == str(w), (name, have, w)
+                continue
+            assert abs(have_f - want_f) <= 1e-9 * max(
+                1.0, abs(want_f)
+            ), (name, have_f, want_f)
+        print(f"suite {i}: fleet metrics == whole-table ({run['status']})")
+    print(
+        "distributed service (2 processes, loopback, sharded feed): "
+        "fleet metrics == whole-table"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -4,10 +4,11 @@ ours must RUN, so a signature drift in the public API fails loudly
 here instead of shipping silently).
 
 Each example's ``main()`` runs in-process on the suite's 8-virtual-
-device CPU backend (conftest).  ``multihost_profiling`` and
-``multihost_grouping`` are excluded HERE only because
-``tests/test_multihost.py`` already executes them as two-real-process
-subprocess runs — together the suite runs every example."""
+device CPU backend (conftest).  ``multihost_profiling``,
+``multihost_grouping`` and ``distributed_service`` are excluded HERE
+only because ``tests/test_multihost.py`` already executes them as
+two-real-process subprocess runs — together the suite runs every
+example."""
 
 import importlib
 import os
@@ -47,6 +48,7 @@ def test_every_example_is_covered():
     assert _all_examples() == set(_IN_PROCESS) | {
         "multihost_profiling",
         "multihost_grouping",
+        "distributed_service",
     }
 
 

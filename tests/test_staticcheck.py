@@ -1144,6 +1144,74 @@ class TestThreadDiscipline:
 
 
 # --------------------------------------------------------------------------
+# placement scope (this PR): the elastic placer joins the service
+# discipline — thread sanction, injected clocks, admission layering
+# --------------------------------------------------------------------------
+
+
+PLACEMENT_REL = "deequ_tpu/service/placement.py"
+
+
+class TestPlacementScope:
+    def test_placement_is_a_sanctioned_thread_module(self, tmp_path):
+        # the same registered spawn that is legal in ingest.py is
+        # legal in placement.py — the sanction list covers it
+        _write(tmp_path, PLACEMENT_REL, THREAD_REGISTERED_WRAPPED)
+        assert _rules_found(tmp_path, "thread-discipline") == []
+
+    def test_sanction_still_demands_registration(self, tmp_path):
+        _write(tmp_path, PLACEMENT_REL, THREAD_UNREGISTERED)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "register_ingest_thread" in found[0].message
+
+    def test_unbounded_queue_in_placement_flags(self, tmp_path):
+        _write(tmp_path, PLACEMENT_REL, QUEUE_UNBOUNDED)
+        found = _rules_found(tmp_path, "thread-discipline")
+        assert len(found) == 1
+        assert "maxsize" in found[0].message
+
+    def test_wall_clock_wait_in_placement_flags(self, tmp_path):
+        # lease waits must ride the injected clock's queue_poll_s —
+        # a raw sleep would make DevicePool untestable on fake time
+        _write(
+            tmp_path,
+            PLACEMENT_REL,
+            """
+            import time
+
+            def wait_for_slice(pool):
+                time.sleep(0.25)
+            """,
+        )
+        found = _rules_found(tmp_path, "service-time")
+        # both the attribute chain and the bare NAME register
+        assert {f.symbol for f in found} == {"time.sleep", "sleep"}
+
+    def test_engine_entry_from_placement_flags(self, tmp_path):
+        # the placer hands out leases; executing scans is the
+        # scheduler's job, through the runner's admission layer
+        _write(
+            tmp_path,
+            PLACEMENT_REL,
+            """
+            def place_and_run(engine, plan):
+                return engine.execute_plan(plan)
+            """,
+        )
+        found = _rules_found(tmp_path, "service-admission")
+        assert [f.symbol for f in found] == ["execute_plan"]
+
+    def test_shipped_placement_module_is_clean(self):
+        found = [
+            f
+            for f in unwaived(run_analyzers(REPO_ROOT))
+            if f.path == PLACEMENT_REL
+        ]
+        assert found == []
+
+
+# --------------------------------------------------------------------------
 # subprocess-discipline
 # --------------------------------------------------------------------------
 

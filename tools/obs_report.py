@@ -453,6 +453,83 @@ def render_service(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_placement(records: List[Dict[str, Any]]) -> str:
+    """The ``placement:`` section (docs/SERVICE.md "Elastic
+    placement"): how many runs were placed, the slice-size
+    distribution, lease-wait percentiles, corrupt-compile-cache
+    discards, and the per-shape plan-cache hit/compile split — the
+    elastic acceptance question ("is every shape compile-free?") from
+    one JSONL artifact. Empty string when nothing was placed."""
+    events = [r for r in records if r.get("type") == "event"]
+    placed = [e for e in events if e.get("event") == "run_placed"]
+
+    counters: Dict[str, float] = {}
+    for r in load_runs(records):
+        for k, v in r.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    per_shape_keys = [
+        k for k in counters
+        if k.startswith("engine.plan_cache.per_shape.")
+    ]
+    if not placed and not per_shape_keys:
+        return ""
+
+    lines = ["placement:"]
+    if placed:
+        by_ndev: Dict[int, int] = {}
+        for e in placed:
+            ndev = int(e.get("ndev", 0))
+            by_ndev[ndev] = by_ndev.get(ndev, 0) + 1
+        dist = ", ".join(
+            f"{n}dev x{c}" for n, c in sorted(by_ndev.items())
+        )
+        lines.append(f"  placements: {len(placed)} ({dist})")
+        waits = sorted(
+            float(e.get("lease_wait_s", 0.0)) for e in placed
+        )
+        lines.append(
+            f"  lease wait: p50={_percentile(waits, 0.50):.3f}s"
+            f" p90={_percentile(waits, 0.90):.3f}s"
+            f" p99={_percentile(waits, 0.99):.3f}s"
+            f" max={waits[-1]:.3f}s"
+        )
+        # which devices actually saw work — disjointness at a glance
+        device_sets = sorted(
+            {str(e.get("device_ids", "?")) for e in placed}
+        )
+        lines.append(f"  slices used: {'; '.join(device_sets)}")
+    if per_shape_keys:
+        lines.append("  plan cache per shape:")
+        labels = sorted(
+            {
+                k[len("engine.plan_cache.per_shape."):].rsplit(".", 1)[0]
+                for k in per_shape_keys
+            }
+        )
+        for label in labels:
+            hits = int(
+                counters.get(
+                    f"engine.plan_cache.per_shape.{label}.hits", 0
+                )
+            )
+            misses = int(
+                counters.get(
+                    f"engine.plan_cache.per_shape.{label}.misses", 0
+                )
+            )
+            lines.append(
+                f"    {label:<8} hits={hits} compiles={misses}"
+            )
+    corrupt = int(counters.get("engine.compile_cache_corrupt", 0)) or sum(
+        1 for e in events if e.get("event") == "compile_cache_corrupt"
+    )
+    if corrupt:
+        lines.append(
+            f"  corrupt compile-cache entries discarded: {corrupt}"
+        )
+    return "\n".join(lines)
+
+
 def render_crash_recovery(records: List[Dict[str, Any]]) -> str:
     """The ``crash recovery:`` section (docs/RESILIENCE.md): child
     crashes by signal, relaunches and checkpoint resumes, crash loops
@@ -590,6 +667,7 @@ def render(
     counters_only: bool = False,
     service_only: bool = False,
     crashes_only: bool = False,
+    placement_only: bool = False,
 ) -> str:
     if service_only:
         section = render_service(records)
@@ -597,6 +675,9 @@ def render(
     if crashes_only:
         section = render_crash_recovery(records)
         return section or "no crash/recovery signals in artifact"
+    if placement_only:
+        section = render_placement(records)
+        return section or "no placement signals in artifact"
     runs = load_runs(records)
     if run_id is not None:
         runs = [r for r in runs if r.get("run_id") == run_id]
@@ -629,6 +710,9 @@ def render(
         section = render_service(records)
         if section:
             body = body + "\n\n" + section
+        placement_section = render_placement(records)
+        if placement_section:
+            body = body + "\n\n" + placement_section
         crash_section = render_crash_recovery(records)
         if crash_section:
             body = body + "\n\n" + crash_section
@@ -658,6 +742,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print only the crash isolation / recovery section",
     )
     parser.add_argument(
+        "--placement", action="store_true",
+        help="print only the elastic device placement section",
+    )
+    parser.add_argument(
         "--staticcheck", action="store_true",
         help="append the one-line static-analysis summary "
         "(tools.staticcheck); usable without a JSONL path",
@@ -680,6 +768,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         counters_only=args.counters,
         service_only=args.service,
         crashes_only=args.crashes,
+        placement_only=args.placement,
     ))
     if args.staticcheck:
         print(render_staticcheck())

@@ -136,11 +136,13 @@ def synthetic_dataset(schema, rows: int, nullable: bool, wide_ints: bool,
 
 def warm_once(schema, rows, nullable, wide_ints, suite: bool,
               high_card_strings: bool = False, checks=None,
-              profile: bool = True) -> float:
+              profile: bool = True, engine=None) -> float:
     """One warm pass: the ColumnProfiler plan (unless ``profile=False``)
     plus a VerificationSuite plan — either the EXACT production
     ``checks`` (the service warms the suites it will actually serve) or
-    a synthesized schema-shaped check when ``suite=True``."""
+    a synthesized schema-shaped check when ``suite=True``. ``engine``
+    pins a specific ``AnalysisEngine`` (e.g. a mesh over an elastic
+    device slice) so the pass warms THAT placement shape's plan."""
     ds = synthetic_dataset(
         schema, rows, nullable, wide_ints,
         high_card_strings=high_card_strings,
@@ -149,14 +151,16 @@ def warm_once(schema, rows, nullable, wide_ints, suite: bool,
     if profile:
         from deequ_tpu.profiles.profiler import ColumnProfiler
 
-        ColumnProfiler.profile(ds)
+        ColumnProfiler.profile(ds, engine=engine)
     if checks is not None:
         from deequ_tpu import VerificationSuite
 
         # compiles key on structure/shapes/dtypes, never values — a
         # synthetic dataset with the production schema warms the
         # production suite's plan exactly
-        VerificationSuite().on_data(ds).add_checks(list(checks)).run()
+        VerificationSuite().on_data(ds).add_checks(
+            list(checks)
+        ).with_engine(engine).run()
     elif suite:
         from deequ_tpu import Check, CheckLevel, VerificationSuite
 
@@ -168,7 +172,9 @@ def warm_once(schema, rows, nullable, wide_ints, suite: bool,
             if kind in ("int32", "int64", "string"):
                 check = check.is_unique(name)
         # the profiler's dataset warms the suite plan equally well
-        VerificationSuite().on_data(ds).add_check(check).run()
+        VerificationSuite().on_data(ds).add_check(check).with_engine(
+            engine
+        ).run()
     return time.time() - t0
 
 
@@ -209,6 +215,33 @@ def default_engine_variants(schema) -> list:
     return variants
 
 
+def _mesh_engines(mesh_shapes):
+    """(label, engine-or-None) per requested placement shape. ``None``
+    in ``mesh_shapes`` warms the default (host/whole-backend) engine; an
+    integer ``n`` warms an n-device ``Mesh`` — the SAME shape-keyed plan
+    entry (engine/scan.py ``_placement_shape``) the elastic placer's
+    n-device slices execute, whichever concrete devices the pool hands
+    out. Shapes exceeding the host's device count are skipped (warming
+    a shape the pool can never grant is dead work)."""
+    engines = []
+    for shape in mesh_shapes:
+        if shape is None:
+            engines.append(("default", None))
+            continue
+        import jax
+        from jax.sharding import Mesh
+
+        from deequ_tpu.engine.scan import AnalysisEngine
+
+        devices = jax.devices()
+        n = int(shape)
+        if n < 1 or n > len(devices):
+            continue
+        mesh = Mesh(np.array(devices[:n]), ("dp",))
+        engines.append((f"mesh{n}", AnalysisEngine(mesh=mesh)))
+    return engines
+
+
 def warm_plans(
     schema,
     suite: bool = False,
@@ -219,11 +252,16 @@ def warm_plans(
     engine_variants=None,
     checks=None,
     profile: bool = True,
+    mesh_shapes=(None,),
     log=None,
 ) -> dict:
     """Warm every fused-plan variant for ``schema`` and REPORT what got
     warmed — the reusable core behind both the CLI and the
     verification service's startup warmup (deequ_tpu/service).
+
+    ``mesh_shapes`` extends the sweep across placement shapes: each
+    entry is ``None`` (the default engine) or a device count ``n`` (an
+    n-device mesh — the shape an elastic n-device slice executes).
 
     Returns ``{"tokens": [...], "already_warm": int, "passes": int,
     "total_s": float}`` where ``tokens`` are the structural plan-cache
@@ -248,6 +286,7 @@ def warm_plans(
     if engine_variants is None:
         engine_variants = default_engine_variants(schema)
 
+    engines = _mesh_engines(mesh_shapes)
     before = set(plan_cache_snapshot())
     total = 0.0
     passes = 0
@@ -256,22 +295,26 @@ def warm_plans(
             " ".join(f"{k}={v}" for k, v in variant.items()) or "default"
         )
         with config.configure(batch_size=batch, **variant):
-            for null in nullable:
-                for wide in wide_ints:
-                    for high_card in high_card_strings:
-                        t = warm_once(
-                            schema, rows, null, wide, suite,
-                            high_card_strings=high_card,
-                            checks=checks, profile=profile,
-                        )
-                        total += t
-                        passes += 1
-                        if log is not None:
-                            log(
-                                f"  warmed [{tag}] nullable={null} "
-                                f"wide_ints={wide} "
-                                f"high_card_strings={high_card}: {t:.1f}s"
+            for shape_tag, engine in engines:
+                for null in nullable:
+                    for wide in wide_ints:
+                        for high_card in high_card_strings:
+                            t = warm_once(
+                                schema, rows, null, wide, suite,
+                                high_card_strings=high_card,
+                                checks=checks, profile=profile,
+                                engine=engine,
                             )
+                            total += t
+                            passes += 1
+                            if log is not None:
+                                log(
+                                    f"  warmed [{tag}/{shape_tag}] "
+                                    f"nullable={null} "
+                                    f"wide_ints={wide} "
+                                    f"high_card_strings={high_card}: "
+                                    f"{t:.1f}s"
+                                )
     after = plan_cache_snapshot()
     tokens = [t for t in after if t not in before]
     return {
@@ -308,6 +351,12 @@ def main() -> int:
         "--suite", action="store_true",
         help="also warm a VerificationSuite-shaped plan",
     )
+    parser.add_argument(
+        "--mesh-shapes", default=None,
+        help="comma-separated device counts to warm as mesh placement "
+        "shapes (e.g. '1,2,4' for an elastic-placement service); "
+        "'default' entries warm the host engine",
+    )
     args = parser.parse_args()
 
     if bool(args.schema) == bool(args.like_parquet):
@@ -339,6 +388,14 @@ def main() -> int:
     }[args.string_cardinality]
     has_int64 = any(k == "int64" for k in schema.values())
 
+    mesh_shapes = (None,)
+    if args.mesh_shapes:
+        mesh_shapes = tuple(
+            None if part.strip() == "default" else int(part)
+            for part in args.mesh_shapes.split(",")
+            if part.strip()
+        )
+
     report = warm_plans(
         schema,
         suite=args.suite,
@@ -346,6 +403,7 @@ def main() -> int:
         nullable=nullables,
         wide_ints=widths if has_int64 else (False,),
         high_card_strings=cards,
+        mesh_shapes=mesh_shapes,
         log=print,
     )
     tokens = ", ".join(report["tokens"]) or "(all already resident)"
