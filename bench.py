@@ -2046,48 +2046,82 @@ def main(argv=None):
         """ONE config through a spawn-started child (crash isolation:
         a config that segfaults or stalls becomes a status entry, not
         the end of the bench). Fills detail[name] on success and
-        detail["config_status"][name] always."""
+        detail["config_status"][name] always. A child CRASH (killed by
+        a signal — usually the OOM killer on a small host) steps the
+        config's row count down by halving and retries, so EVERY
+        config yields a number somewhere on any host; the step-down
+        trail rides the status entry (``row_step_downs``,
+        ``rows_effective``)."""
+        cfg_args = dict(cfg_args)
         status = {"rows": cfg_args.get("rows"), "estimated_s": est_s}
         t0 = time.time()
-        payload = {"name": name, "args": cfg_args}
-        restore_env = _apply_child_env(name)
-        try:
-            if args.inline:
-                detail[name] = _bench_child(payload)
-            else:
-                from deequ_tpu.engine.subproc import IsolatedRunner
+        step_downs: list = []
+        while True:
+            payload = {"name": name, "args": dict(cfg_args)}
+            restore_env = _apply_child_env(name)
+            try:
+                if args.inline:
+                    detail[name] = _bench_child(payload)
+                else:
+                    from deequ_tpu.engine.subproc import IsolatedRunner
 
-                runner = IsolatedRunner(
-                    key=f"bench:{name}",
-                    # bench configs are not checkpointer-resumable, so
-                    # one crash = one failed config, no relaunch
-                    max_relaunches=1,
-                    use_breaker=False,
-                    timeout_s=max(120.0, min(remaining(), est_s * 3.0)),
+                    runner = IsolatedRunner(
+                        key=f"bench:{name}",
+                        # bench configs are not checkpointer-resumable,
+                        # so one crash = one failed attempt, no relaunch
+                        max_relaunches=1,
+                        use_breaker=False,
+                        timeout_s=max(120.0, min(remaining(), est_s * 3.0)),
+                    )
+                    detail[name] = runner.run(_bench_child, payload)
+                status["status"] = "ok"
+                # a success after step-downs is a success — the trail
+                # below documents the crashes that led here
+                for key in ("error", "signal", "exitcode"):
+                    status.pop(key, None)
+                break
+            except BaseException as exc:  # noqa: BLE001 — a status, never a crash
+                sig = getattr(exc, "last_signal", None) or getattr(
+                    exc, "signal_name", None
                 )
-                detail[name] = runner.run(_bench_child, payload)
-            status["status"] = "ok"
-        except BaseException as exc:  # noqa: BLE001 — a status, never a crash
-            sig = getattr(exc, "last_signal", None) or getattr(
-                exc, "signal_name", None
-            )
-            rc = getattr(exc, "last_exitcode", None)
-            if rc is None:
-                rc = getattr(exc, "exitcode", None)
-            if sig == "timeout":
-                status["status"] = "timeout"
-            elif sig is not None or rc is not None:
-                status["status"] = "crashed"
-            else:
-                status["status"] = "error"
-            status["error"] = repr(exc)
-            if sig is not None:
-                status["signal"] = sig
-            if rc is not None:
-                status["exitcode"] = rc
-            detail.setdefault("errors", {})[name] = repr(exc)
-        finally:
-            restore_env()
+                rc = getattr(exc, "last_exitcode", None)
+                if rc is None:
+                    rc = getattr(exc, "exitcode", None)
+                if sig == "timeout":
+                    status["status"] = "timeout"
+                elif sig is not None or rc is not None:
+                    status["status"] = "crashed"
+                else:
+                    status["status"] = "error"
+                status["error"] = repr(exc)
+                if sig is not None:
+                    status["signal"] = sig
+                if rc is not None:
+                    status["exitcode"] = rc
+                rows = cfg_args.get("rows")
+                if (
+                    status["status"] == "crashed"
+                    and isinstance(rows, int)
+                    and rows // 2 >= 100_000
+                    and len(step_downs) < 3
+                ):
+                    cfg_args["rows"] = rows // 2
+                    step_downs.append(cfg_args["rows"])
+                    print(
+                        f"[bench] {name} crashed at {rows} rows "
+                        f"({sig or rc}); stepping down to "
+                        f"{cfg_args['rows']}",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    continue
+                detail.setdefault("errors", {})[name] = repr(exc)
+                break
+            finally:
+                restore_env()
+        if step_downs:
+            status["row_step_downs"] = step_downs
+            status["rows_effective"] = cfg_args.get("rows")
         status["wall_s"] = round(time.time() - t0, 1)
         detail["config_status"][name] = status
         detail.setdefault("config_walls", {})[name] = status["wall_s"]

@@ -430,7 +430,10 @@ class AnalysisRunner:
         # anomaly strategies can trend the system's own throughput
         if metrics_repository is not None and save_or_append_results_with_key is not None:
             from deequ_tpu.repository.base import AnalysisResult
+            from deequ_tpu.telemetry import clock as _wall_clock
 
+            _tm = get_telemetry()
+            _t0 = _wall_clock()
             current = metrics_repository.load_by_key(
                 save_or_append_results_with_key
             )
@@ -448,6 +451,16 @@ class AnalysisRunner:
             metrics_repository.save(
                 AnalysisResult(save_or_append_results_with_key, combined)
             )
+            # traced runs record the repository round trip as a child
+            # span — one emit per run, nothing when untraced
+            if _tm.current_trace() is not None:
+                _tm.emit_span(
+                    "persist",
+                    _wall_clock() - _t0,
+                    dataset_date=getattr(
+                        save_or_append_results_with_key, "dataset_date", 0
+                    ),
+                )
 
         return context
 

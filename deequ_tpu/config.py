@@ -331,6 +331,26 @@ class Options:
     service_placement_mesh_cache_slices: int = int(
         os.environ.get("DEEQU_TPU_SERVICE_PLACEMENT_MESH_SLICES", 8) or 8
     )
+    # end-to-end run tracing (docs/OBSERVABILITY.md "Tracing"): every
+    # submission is minted a TraceContext at enqueue and the span tree
+    # follows it across workers, coalesced groups, placement leases,
+    # and the spawn boundary. Opt-in: default-off emits not one extra
+    # span and adds no per-batch work above the existing PhaseClock
+    service_trace: bool = (
+        os.environ.get("DEEQU_TPU_SERVICE_TRACE", "0") == "1"
+    )
+    # live observability plane (telemetry/export.py serve_metrics):
+    # port for the stdlib HTTP endpoint exposing /metrics (Prometheus
+    # text) and /healthz (JSON health snapshot); 0 = no endpoint thread
+    service_metrics_port: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_METRICS_PORT", 0) or 0
+    )
+    # per-class queue-wait latency objectives for the SloTracker, as
+    # "class=seconds" pairs ("interactive=1.0,batch=30"); "" disables
+    # SLO tracking (no tracker allocated, no oprecords persisted)
+    service_slo_objectives: str = os.environ.get(
+        "DEEQU_TPU_SERVICE_SLO_OBJECTIVES", ""
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -556,7 +556,11 @@ class QuarantineWriter:
         """Drain trailing quarantined units (a failure after the last
         fold), close the parquet writers (writing empty files for a
         split that never materialized, so consumers can always read
-        both), and return (rows_clean, rows_quarantined)."""
+        both), and return (rows_clean, rows_quarantined). Traced runs
+        record the flush as one ``egress`` child span."""
+        from deequ_tpu.telemetry import clock as _wall_clock
+
+        _t0 = _wall_clock()
         self._refresh_failures(record)
         self._drain_failures()
         if not interrupted and self.cursor != self.num_rows:
@@ -584,6 +588,13 @@ class QuarantineWriter:
         tm = get_telemetry()
         tm.counter("engine.rows_clean").inc(self.rows_clean)
         tm.counter("engine.rows_quarantined").inc(self.rows_quarantined)
+        if tm.current_trace() is not None:
+            tm.emit_span(
+                "egress",
+                _wall_clock() - _t0,
+                rows_clean=self.rows_clean,
+                rows_quarantined=self.rows_quarantined,
+            )
         return self.rows_clean, self.rows_quarantined
 
     def abort(self) -> None:

@@ -54,6 +54,14 @@ from deequ_tpu.engine.resilience import TransientScanError
 #: jax_platforms programmatically — children do not inherit jax.config)
 CHILD_PLATFORM_ENV = "DEEQU_TPU_CHILD_JAX_PLATFORM"
 
+#: env var carrying the parent's ``TraceContext.encode()`` across the
+#: spawn boundary (trace_id : parent span id : process label) — the
+#: only channel that survives spawn. When present, the child roots its
+#: spans under the parent's span and STREAMS each finished span back
+#: over the pipe as a ``("span", record)`` message ahead of the final
+#: result tuple, so the parent still sees where a crashed child died.
+CHILD_TRACE_ENV = "DEEQU_TPU_CHILD_TRACE"
+
 
 class ProcessCrashed(TransientScanError):
     """The child process died without delivering a result — killed by a
@@ -221,6 +229,15 @@ def reset_breakers() -> None:
         _breakers.clear()
 
 
+def breaker_states() -> Dict[str, str]:
+    """The state of every registered crash-loop breaker, keyed by plan
+    key — surfaced in the service's ``/healthz`` payload so a fleet
+    dashboard sees tripped plans without scraping events."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {key: breaker.state for key, breaker in items}
+
+
 # --------------------------------------------------------------------------
 # Checkpoint progress probe
 # --------------------------------------------------------------------------
@@ -275,21 +292,50 @@ def _apply_child_platform() -> None:
         pass
 
 
+def _child_trace(tm: Any) -> Optional[Any]:
+    """Decode the parent's shipped trace (``CHILD_TRACE_ENV``) into the
+    child's ambient context, re-tagged with a ``/child`` process label
+    so a merged fleet timeline tells the two processes apart."""
+    from deequ_tpu.telemetry.spans import TraceContext
+
+    ctx = TraceContext.decode(os.environ.get(CHILD_TRACE_ENV, ""))
+    if ctx is None or not tm.enabled:
+        return None
+    label = f"{ctx.process}/child" if ctx.process else "child"
+    return TraceContext(ctx.trace_id, ctx.span_id, process=label)
+
+
 def _child_main(conn: Any, fn: Callable[[Any], Any], payload: Any) -> None:
     """Spawn entry point: run ``fn(payload)`` and ship ``("ok", result,
     telemetry_summary)`` or ``("err", exception, telemetry_summary)``
     back over the pipe. Anything that cannot pickle degrades to a
     :class:`_ChildError` carrier; a crash ships nothing and the parent
-    classifies the exit status instead."""
+    classifies the exit status instead — though every span finished
+    BEFORE the crash has already streamed out as a ``("span", record)``
+    message, so the parent's trace still shows where the child died."""
     import traceback
 
     _apply_child_platform()
     from deequ_tpu.telemetry import get_telemetry
 
     tm = get_telemetry()
+    ctx = _child_trace(tm)
+    send_lock = threading.Lock()
+    if ctx is not None:
+
+        def _stream_span(record: Dict[str, Any]) -> None:
+            try:
+                with send_lock:
+                    conn.send(("span", record))
+            except Exception:  # noqa: BLE001 — parent gone/pipe torn:
+                # span streaming is best-effort, never fails the run
+                pass
+
+        tm.add_span_sink(_stream_span)
     try:
-        with tm.run("isolated_child") as cap:
-            result = fn(payload)
+        with tm.trace_scope(ctx):
+            with tm.run("isolated_child") as cap:
+                result = fn(payload)
         message = ("ok", result, cap.final)
     except BaseException as exc:  # lint-ok: interrupt-swallow: child-side boundary — the exception (interrupts included) is pickled and shipped to the parent, which re-raises it; swallowing here IS the delivery
         summary = None
@@ -309,20 +355,22 @@ def _child_main(conn: Any, fn: Callable[[Any], Any], payload: Any) -> None:
                 summary,
             )
     try:
-        conn.send(message)
+        with send_lock:
+            conn.send(message)
     except Exception:  # noqa: BLE001 — unpicklable RESULT: report, not crash
-        conn.send(
-            (
-                "err",
-                _ChildError(
-                    "UnpicklableResult",
-                    f"child result of type "
-                    f"{type(message[1]).__name__} cannot cross the pipe",
-                    "",
-                ),
-                None,
+        with send_lock:
+            conn.send(
+                (
+                    "err",
+                    _ChildError(
+                        "UnpicklableResult",
+                        f"child result of type "
+                        f"{type(message[1]).__name__} cannot cross the pipe",
+                        "",
+                    ),
+                    None,
+                )
             )
-        )
     finally:
         conn.close()
 
@@ -408,16 +456,68 @@ class IsolatedRunner:
         platform = _parent_platform()
         if platform:
             os.environ[CHILD_PLATFORM_ENV] = platform
-        proc.start()
+        # ship the ambient trace, re-anchored at the parent's CURRENT
+        # open span, so child spans nest where the launch happened. The
+        # env var is restored right after start() — spawn snapshots the
+        # environment at launch, and a stale context must never leak
+        # into a later untraced child.
+        shipped_parent: Optional[int] = None
+        shipped = None
+        ctx = tm.current_trace()
+        if ctx is not None:
+            current = tm.tracer.current()
+            shipped_parent = (
+                current.span_id if current is not None else ctx.span_id
+            )
+            shipped = ctx.child(shipped_parent)
+        prev_trace_env = os.environ.get(CHILD_TRACE_ENV)
+        if shipped is not None:
+            os.environ[CHILD_TRACE_ENV] = shipped.encode()
+        else:
+            os.environ.pop(CHILD_TRACE_ENV, None)
+        try:
+            proc.start()
+        finally:
+            if prev_trace_env is None:
+                os.environ.pop(CHILD_TRACE_ENV, None)
+            else:
+                os.environ[CHILD_TRACE_ENV] = prev_trace_env
         child_conn.close()  # parent's copy; the child holds the real end
         message = None
-        replied = False
+        poll_expired = False
         timed_out = False
+        spans: list = []
+        clk = MonotonicClock()
+        deadline = (
+            clk.now() + self.timeout_s if self.timeout_s is not None else None
+        )
         try:
             try:
-                if parent_conn.poll(self.timeout_s):
-                    replied = True  # data OR EOF — either way, not a timeout
-                    message = parent_conn.recv()
+                # drain ("span", record) streaming messages until the
+                # final ("ok"|"err", value, summary) 3-tuple, EOF, or
+                # the deadline. Spans collected here survive a crash —
+                # they are replayed below even when no final message
+                # ever arrives, so the trace shows where the child died.
+                while True:
+                    remaining = (
+                        None
+                        if deadline is None
+                        else max(0.0, deadline - clk.now())
+                    )
+                    if not parent_conn.poll(remaining):
+                        poll_expired = True
+                        break
+                    msg = parent_conn.recv()
+                    if (
+                        isinstance(msg, tuple)
+                        and len(msg) == 2
+                        and msg[0] == "span"
+                    ):
+                        if isinstance(msg[1], dict):
+                            spans.append(msg[1])
+                        continue
+                    message = msg
+                    break
             except (EOFError, OSError):
                 message = None  # pipe torn by a crashing child
             # timeout means poll() genuinely expired. An EOF wakes poll()
@@ -426,7 +526,7 @@ class IsolatedRunner:
             # must never be misread as a timeout.
             if (
                 message is None
-                and not replied
+                and poll_expired
                 and self.timeout_s is not None
                 and proc.is_alive()
             ):
@@ -441,6 +541,13 @@ class IsolatedRunner:
             exitcode = proc.exitcode
             proc.close()
 
+        # replay streamed child spans into the parent's telemetry on
+        # EVERY outcome — success, error, crash, timeout. Ids remap onto
+        # the parent's counter; parentage re-roots under the span the
+        # launch shipped.
+        if spans:
+            tm.replay_spans(spans, root_parent_id=shipped_parent)
+
         if timed_out:
             tm.counter("engine.child_crashes").inc()
             tm.event(
@@ -449,6 +556,7 @@ class IsolatedRunner:
                 exitcode=exitcode,
                 signal="timeout",
                 launches=launches,
+                spans_streamed=len(spans),
             )
             raise ProcessCrashed(
                 f"child exceeded {self.timeout_s}s and was terminated",
@@ -465,6 +573,7 @@ class IsolatedRunner:
                 exitcode=exitcode,
                 signal=signal_name,
                 launches=launches,
+                spans_streamed=len(spans),
             )
             raise ProcessCrashed(
                 description,

@@ -36,7 +36,43 @@ from deequ_tpu.engine.deadline import (
     RunBudget,
     RunCancelled,
 )
-from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.telemetry import TraceContext, get_telemetry
+
+
+def finish_ticket_trace(ticket: "RunTicket", status: str,
+                        queue_wait_s: Optional[float] = None) -> None:
+    """Emit the ticket's synthetic root span (span_id reserved at mint)
+    once its handle is terminal — EVERY terminal path routes here so a
+    traced run always has exactly one root. ``queue_wait_s`` adds the
+    queue-wait child for tickets that died without ever starting (the
+    scheduler emits it itself for tickets it started)."""
+    ctx = ticket.trace
+    if ctx is None:
+        return
+    tm = get_telemetry()
+    handle = ticket.handle
+    submitted = ticket.submitted_at or 0.0
+    finished = handle.finished_at
+    wall = max(0.0, (finished - submitted)) if finished is not None else 0.0
+    if queue_wait_s is not None:
+        tm.emit_span(
+            "queue_wait",
+            queue_wait_s,
+            trace=ctx,
+            parent_id=ctx.span_id,
+            priority=Priority.name(handle.priority),
+        )
+    tm.emit_span(
+        "ticket",
+        wall,
+        trace=ctx,
+        span_id=ctx.span_id,
+        parent_id=None,
+        run_id=handle.run_id,
+        tenant=handle.tenant,
+        priority=Priority.name(handle.priority),
+        status=status,
+    )
 
 
 class Priority:
@@ -211,6 +247,14 @@ class RunTicket:
     # before execution (service/placement.py); None when elastic
     # placement is off. A coalesced group shares ONE lease object.
     lease: Optional[Any] = None
+    # trace identity minted at push when the queue runs with
+    # trace_enabled (config.service_trace): the span tree of everything
+    # that happens to this run hangs off trace.span_id
+    trace: Optional[TraceContext] = None
+    # last clock reading at which the coalesce policy held this ticket
+    # back as a host (waiting for peers) — the scheduler turns the
+    # difference from submitted_at into the coalesce_window span
+    coalesce_held_until: float = 0.0
 
     @property
     def sort_key(self):
@@ -229,10 +273,14 @@ class RunQueue:
         clock: Any = None,
         tenant_max_pending: int = 0,
         tenant_max_active: int = 0,
+        trace_enabled: bool = False,
+        process_label: str = "",
     ):
         self.clock = clock or MonotonicClock()
         self.tenant_max_pending = int(tenant_max_pending)
         self.tenant_max_active = int(tenant_max_active)
+        self.trace_enabled = bool(trace_enabled)
+        self.process_label = process_label
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._seq = 0
@@ -264,6 +312,10 @@ class RunQueue:
             ticket.seq = self._seq
             ticket.submitted_at = self.clock.now()
             ticket.handle.submitted_at = ticket.submitted_at
+            if self.trace_enabled and ticket.trace is None:
+                ticket.trace = TraceContext.mint(
+                    ticket.handle.run_id, process=self.process_label
+                )
             if ticket.budget is not None:
                 ticket.budget.start()  # queue wait burns the deadline
             self._queued.append(ticket)
@@ -293,6 +345,11 @@ class RunQueue:
                 tenant=handle.tenant,
                 reason="cancelled while queued",
             )
+            finish_ticket_trace(
+                ticket,
+                RunState.CANCELLED,
+                queue_wait_s=handle.finished_at - ticket.submitted_at,
+            )
             return True
         if ticket.budget is not None and ticket.budget.expired():
             handle.finished_at = self.clock.now()
@@ -309,6 +366,11 @@ class RunQueue:
                 run_id=handle.run_id,
                 tenant=handle.tenant,
                 reason="deadline expired while queued",
+            )
+            finish_ticket_trace(
+                ticket,
+                RunState.REJECTED,
+                queue_wait_s=handle.finished_at - ticket.submitted_at,
             )
             return True
         return False
@@ -375,6 +437,10 @@ class RunQueue:
                     and policy.compatible(ticket, other) is None
                 )
                 if policy.should_wait(ticket, now, peers):
+                    # remember how long the coalesce window held this
+                    # ticket back — the scheduler splits the eventual
+                    # queue wait into queue_wait + coalesce_window spans
+                    ticket.coalesce_held_until = now
                     continue
             if best is None or ticket.sort_key < best.sort_key:
                 best = ticket
@@ -510,6 +576,13 @@ class RunQueue:
                 run_id=ticket.handle.run_id,
                 tenant=ticket.handle.tenant,
                 reason=reason,
+            )
+            finish_ticket_trace(
+                ticket,
+                RunState.CANCELLED,
+                queue_wait_s=(
+                    ticket.handle.finished_at - ticket.submitted_at
+                ),
             )
         if drained:
             tm.counter("service.drained_queued").inc(len(drained))

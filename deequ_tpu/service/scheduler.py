@@ -32,7 +32,13 @@ import threading
 from typing import Any, Callable, List, Optional
 
 from deequ_tpu.engine.deadline import MonotonicClock
-from deequ_tpu.service.queue import Priority, RunQueue, RunState, RunTicket
+from deequ_tpu.service.queue import (
+    Priority,
+    RunQueue,
+    RunState,
+    RunTicket,
+    finish_ticket_trace,
+)
 from deequ_tpu.telemetry import get_telemetry
 
 QUEUE_WAIT_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
@@ -54,6 +60,7 @@ class Scheduler:
         ] = None,
         coalesce: Optional[Any] = None,
         placer: Optional[Any] = None,
+        slo_tenants: Optional[Any] = None,
     ):
         self.queue = queue
         self.execute = execute
@@ -75,6 +82,9 @@ class Scheduler:
             max(0, int(interactive_reserve)), self.workers - 1
         )
         self.clock = clock or MonotonicClock()
+        # tenants with an SLO objective get a per-tenant queue-wait
+        # histogram (bounded cardinality: only configured tenants)
+        self.slo_tenants = frozenset(slo_tenants or ())
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -129,6 +139,36 @@ class Scheduler:
             f"service.queue_wait_s.{Priority.name(handle.priority)}",
             buckets=QUEUE_WAIT_BUCKETS,
         ).observe(wait_s)
+        if handle.tenant in self.slo_tenants:
+            tm.metrics.histogram(
+                f"service.queue_wait_s.tenant.{handle.tenant}",
+                buckets=QUEUE_WAIT_BUCKETS,
+            ).observe(wait_s)
+        if ticket.trace is not None:
+            # the wait splits into plain queueing and the coalesce
+            # hold-back window (when the policy held this ticket for
+            # peers); both are children of the ticket root
+            window_s = 0.0
+            if ticket.coalesce_held_until > ticket.submitted_at:
+                window_s = min(
+                    wait_s,
+                    ticket.coalesce_held_until - ticket.submitted_at,
+                )
+            tm.emit_span(
+                "queue_wait",
+                max(0.0, wait_s - window_s),
+                trace=ticket.trace,
+                parent_id=ticket.trace.span_id,
+                priority=Priority.name(handle.priority),
+            )
+            if window_s > 0.0:
+                tm.emit_span(
+                    "coalesce_window",
+                    window_s,
+                    trace=ticket.trace,
+                    parent_id=ticket.trace.span_id,
+                    group_size=group_size,
+                )
         handle._mark_running()
         tm.event(
             "service_run_started",
@@ -153,6 +193,7 @@ class Scheduler:
             status="failed",
             error=repr(exc),
         )
+        finish_ticket_trace(ticket, RunState.FAILED)
 
     def _finish_result(self, ticket: RunTicket, result: Any) -> None:
         tm = get_telemetry()
@@ -184,6 +225,10 @@ class Scheduler:
             wall_s=round(handle.finished_at - handle.started_at, 6),
             interrupted=interruption is not None,
         )
+        finish_ticket_trace(
+            ticket,
+            RunState.CANCELLED if cancelled else RunState.DONE,
+        )
 
     def _finish_outcome(self, ticket: RunTicket, outcome: Any) -> None:
         """Apply a per-member group outcome through the same terminal
@@ -211,6 +256,7 @@ class Scheduler:
             budgets=[t.budget for t in group],
             cancels=[t.handle.cancel_token for t in group],
         )
+        tm = get_telemetry()
         for ticket in group:
             ticket.lease = lease
             ticket.handle.placement = {
@@ -218,7 +264,62 @@ class Scheduler:
                 "device_ids": lease.device_ids,
                 "lease_wait_s": lease.wait_s,
             }
+            if ticket.trace is not None:
+                tm.emit_span(
+                    "lease_wait",
+                    lease.wait_s,
+                    trace=ticket.trace,
+                    parent_id=ticket.trace.span_id,
+                    ndev=lease.ndev,
+                )
         return lease
+
+    # -- execution ------------------------------------------------------
+
+    def _run_group(self, group: List[RunTicket]) -> List[Any]:
+        if len(group) == 1:
+            return [self.execute(group[0])]
+        outcomes = list(self.execute_group(group))
+        if len(outcomes) != len(group):
+            raise RuntimeError(
+                f"execute_group returned {len(outcomes)} "
+                f"outcomes for {len(group)} tickets"
+            )
+        return outcomes
+
+    def _run_group_traced(self, group: List[RunTicket]) -> List[Any]:
+        """Execute under the HOST ticket's trace: the live ``execute``
+        span (and every engine span it nests) lands in the host's tree;
+        each other member gets a ``coalesced_scan`` link span in its OWN
+        trace pointing at the host's execute span — trace_report follows
+        the link to attribute the shared superset scan per member."""
+        tm = get_telemetry()
+        ctx = group[0].trace
+        if ctx is None:
+            return self._run_group(group)
+        esp_holder: List[Any] = []
+        try:
+            with tm.trace_scope(ctx):
+                with tm.span(
+                    "execute", group_size=len(group)
+                ) as esp:
+                    esp_holder.append(esp)
+                    return self._run_group(group)
+        finally:
+            if esp_holder:
+                esp = esp_holder[0]
+                for member in group[1:]:
+                    if member.trace is None:
+                        continue
+                    tm.emit_span(
+                        "coalesced_scan",
+                        esp.wall_s,
+                        trace=member.trace,
+                        parent_id=member.trace.span_id,
+                        link_trace_id=ctx.trace_id,
+                        link_span_id=esp.span_id,
+                        group_size=len(group),
+                    )
 
     # -- the worker loop ------------------------------------------------
 
@@ -247,15 +348,7 @@ class Scheduler:
             for ticket in group:
                 self._mark_started(ticket, len(group))
             try:
-                if len(group) == 1:
-                    outcomes: List[Any] = [self.execute(group[0])]
-                else:
-                    outcomes = list(self.execute_group(group))
-                    if len(outcomes) != len(group):
-                        raise RuntimeError(
-                            f"execute_group returned {len(outcomes)} "
-                            f"outcomes for {len(group)} tickets"
-                        )
+                outcomes: List[Any] = self._run_group_traced(group)
             # lint-ok: interrupt-swallow: the handles are the error
             # channel — _finish(FAILED, error=exc) carries everything
             # (interrupts included) to result(); the worker thread

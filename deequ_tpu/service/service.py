@@ -142,11 +142,48 @@ class VerificationService:
         ] = None,
         elastic_placement: Optional[bool] = None,
         placer: Optional[Any] = None,
+        trace: Optional[bool] = None,
+        metrics_port: Optional[int] = None,
+        slo_objectives: Optional[str] = None,
+        process_label: str = "",
     ):
         from deequ_tpu import config
 
         opts = config.options()
         self.clock = clock or MonotonicClock()
+        # end-to-end tracing (docs/OBSERVABILITY.md "Tracing"): when on,
+        # the queue mints a TraceContext per submission and the
+        # scheduler/engine/spawn layers hang the run's span tree off it
+        self.trace_enabled = bool(
+            opts.service_trace if trace is None else trace
+        )
+        self.process_label = process_label
+        # live plane: explicit metrics_port serves (0 = ephemeral bind);
+        # None defers to config, where 0 means NO endpoint thread
+        self._metrics_port: Optional[int] = (
+            int(metrics_port)
+            if metrics_port is not None
+            else (
+                int(opts.service_metrics_port)
+                if opts.service_metrics_port > 0
+                else None
+            )
+        )
+        self.metrics_server: Optional[Any] = None
+        # per-class/per-tenant latency SLOs over the queue-wait
+        # histograms; "" = no tracker, no snapshot persistence
+        slo_spec = (
+            opts.service_slo_objectives
+            if slo_objectives is None
+            else slo_objectives
+        )
+        self.slo: Optional[Any] = None
+        if slo_spec:
+            from deequ_tpu.telemetry import SloTracker, parse_slo_objectives
+
+            objectives = parse_slo_objectives(slo_spec)
+            if objectives:
+                self.slo = SloTracker(objectives)
         journal_dir = (
             journal_dir
             if journal_dir is not None
@@ -200,6 +237,8 @@ class VerificationService:
                 if tenant_max_active is not None
                 else opts.service_tenant_max_active
             ),
+            trace_enabled=self.trace_enabled,
+            process_label=self.process_label,
         )
         # scan coalescing (docs/SERVICE.md "Scan coalescing"): opt-in;
         # the group executor defaults to the service's own ONLY when
@@ -256,6 +295,11 @@ class VerificationService:
             execute_group=execute_group,
             coalesce=self.coalesce_policy,
             placer=self.placer,
+            slo_tenants=(
+                self.slo.tenant_objectives().keys()
+                if self.slo is not None
+                else None
+            ),
         )
         self._run_seq = 0
         self._handles: Dict[str, RunHandle] = {}
@@ -282,6 +326,12 @@ class VerificationService:
             )
             self._sigterm_watcher.start()
         self.scheduler.start()
+        if self._metrics_port is not None and self.metrics_server is None:
+            from deequ_tpu.telemetry import serve_metrics
+
+            self.metrics_server = serve_metrics(
+                self._metrics_port, health=self.health
+            )
         get_telemetry().event(
             "service_started",
             workers=self.scheduler.workers,
@@ -313,6 +363,9 @@ class VerificationService:
             self.queue.drain_queued("service stopping")
         self._watcher_stop.set()
         self.scheduler.stop(timeout=timeout)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         if self._uninstall_sigterm is not None:
             self._uninstall_sigterm()
             self._uninstall_sigterm = None
@@ -695,6 +748,14 @@ class VerificationService:
         # summary (counter deltas) — recompiles-after-warmup is THE
         # steady-state health signal
         self.plans.record_run(getattr(result, "telemetry", None))
+        if (
+            self.slo is not None
+            and request.metrics_repository is not None
+            and request.result_key is not None
+        ):
+            _persist_slo_records(
+                request.metrics_repository, request.result_key, self.slo
+            )
         return result
 
     # -- isolated (child-process) execution ------------------------------
@@ -865,13 +926,17 @@ class VerificationService:
         finally:
             self.datasets.release(request.dataset_key)
         for ticket, result in zip(tickets, results):
+            _scope_member_telemetry(ticket, result)
             member: RunRequest = ticket.payload
             if (
                 member.metrics_repository is not None
                 and member.result_key is not None
             ):
                 _persist_member_result(
-                    member.metrics_repository, member.result_key, result
+                    member.metrics_repository,
+                    member.result_key,
+                    result,
+                    slo=self.slo,
                 )
         self.plans.record_run(getattr(results[0], "telemetry", None))
         return list(results)
@@ -976,15 +1041,20 @@ class VerificationService:
         except BaseException as exc:  # noqa: BLE001
             return self._execute_members_independently(tickets, exc)
         for ticket, result in zip(tickets, results):
+            if isinstance(result, Exception):
+                continue
+            _scope_member_telemetry(ticket, result)
             member: RunRequest = ticket.payload
             if (
-                isinstance(result, Exception)
-                or member.metrics_repository is None
+                member.metrics_repository is None
                 or member.result_key is None
             ):
                 continue
             _persist_member_result(
-                member.metrics_repository, member.result_key, result
+                member.metrics_repository,
+                member.result_key,
+                result,
+                slo=self.slo,
             )
         if results and not isinstance(results[0], Exception):
             self.plans.record_run(getattr(results[0], "telemetry", None))
@@ -1001,6 +1071,40 @@ class VerificationService:
         if self.placer is not None:
             snap["placement"] = self.placer.snapshot()
         return snap
+
+    def health(self) -> Dict[str, Any]:
+        """The ``/healthz`` payload of the live plane: queue depths,
+        active slices, breaker states, shed counts — everything the
+        future autoscaler (ROADMAP item 2) reads, in one place."""
+        from deequ_tpu.engine.subproc import breaker_states
+
+        tm = get_telemetry()
+        queue_snap = self.queue.snapshot()
+        counters = tm.metrics.counters_snapshot()
+        payload: Dict[str, Any] = {
+            "status": "ok" if self.scheduler.running else "stopped",
+            "queue": queue_snap,
+            "workers": self.scheduler.workers,
+            "breakers": breaker_states(),
+            "shed": {
+                "submissions_shed": counters.get(
+                    "service.submissions_shed", 0
+                ),
+                "drained_queued": counters.get(
+                    "service.drained_queued", 0
+                ),
+                "quota_rejections": counters.get(
+                    "service.quota_rejections", 0
+                ),
+            },
+        }
+        if self.placer is not None:
+            placement = self.placer.snapshot()
+            payload["placement"] = placement
+            payload["slices_active"] = placement.get("active_slices")
+        if self.slo is not None:
+            payload["slo"] = self.slo.snapshot()
+        return payload
 
 
 class _JournalingCheckpointer(ScanCheckpointer):
@@ -1107,12 +1211,14 @@ def _isolated_execute_coalesced(payload: Dict[str, Any]) -> List[Any]:
     return results
 
 
-def _persist_member_result(repository, key, result) -> None:
+def _persist_member_result(repository, key, result, slo=None) -> None:
     """Append one coalesced member's sliced result to its metrics
     repository — the same load/combine/save (with operational records)
     that ``do_analysis_run`` performs for a solo run. The coalesced
     path cannot delegate persistence to the superset run: each member
-    owns a DIFFERENT repository/key pair and only its own slice."""
+    owns a DIFFERENT repository/key pair and only its own slice. When
+    the service tracks SLOs, the current attainment snapshot rides
+    along as ``slo.*`` operational records under the same key."""
     from deequ_tpu.analyzers.runner import AnalyzerContext
     from deequ_tpu.repository.base import AnalysisResult
 
@@ -1136,7 +1242,54 @@ def _persist_member_result(repository, key, result) -> None:
         op = operational_metrics(summary)
         if op:
             combined = combined + AnalyzerContext(op)
+    if slo is not None:
+        from deequ_tpu.telemetry.oprecords import slo_metrics
+
+        sm = slo_metrics(slo.snapshot())
+        if sm:
+            combined = combined + AnalyzerContext(sm)
     repository.save(AnalysisResult(key, combined))
+
+
+def _persist_slo_records(repository, key, slo) -> None:
+    """Append the service's current SLO attainment snapshot as
+    operational records under a run's ``ResultKey`` — error-budget
+    burn becomes one more metric series the existing anomaly
+    strategies can trend, with zero new query machinery."""
+    from deequ_tpu.analyzers.runner import AnalyzerContext
+    from deequ_tpu.repository.base import AnalysisResult
+    from deequ_tpu.telemetry.oprecords import slo_metrics
+
+    records = slo_metrics(slo.snapshot())
+    if not records:
+        return
+    context = AnalyzerContext(records)
+    current = repository.load_by_key(key)
+    combined = (
+        current.analyzer_context + context
+        if current is not None
+        else context
+    )
+    repository.save(AnalysisResult(key, combined))
+
+
+def _scope_member_telemetry(ticket, result) -> None:
+    """Re-scope a coalesced member's telemetry provenance: the
+    superset scan executed ONCE under the host ticket's trace, but
+    each member's sliced result must carry spans attributed to its OWN
+    trace_id — otherwise every member's persisted summary points at
+    the host run and a fleet timeline double-attributes the work."""
+    trace = getattr(ticket, "trace", None)
+    summary = getattr(result, "telemetry", None)
+    if trace is None or not isinstance(summary, dict):
+        return
+    scoped = dict(summary)
+    scoped["trace_id"] = trace.trace_id
+    scoped["spans"] = [
+        dict(sp, trace_id=trace.trace_id)
+        for sp in (summary.get("spans") or [])
+    ]
+    result.telemetry = scoped
 
 
 def _crash_loop_result(exc: CrashLoopError, policy: str):

@@ -22,8 +22,12 @@ else becomes a shared no-op.
 """
 
 from deequ_tpu.telemetry.export import (
+    MetricsServer,
+    SloTracker,
     merge_summaries,
+    parse_slo_objectives,
     read_jsonl,
+    serve_metrics,
     summarize_phases,
     summary_from_json,
     summary_to_json,
@@ -45,6 +49,7 @@ from deequ_tpu.telemetry.runtime import (
 from deequ_tpu.telemetry.spans import (
     NOOP_SPAN,
     Span,
+    TraceContext,
     Tracer,
     clock,
     profiler_trace,
@@ -56,19 +61,24 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "MetricsServer",
     "NOOP_SPAN",
     "PhaseClock",
     "RunCapture",
     "RunListener",
+    "SloTracker",
     "Span",
     "Telemetry",
+    "TraceContext",
     "Tracer",
     "clock",
     "configure",
     "get_telemetry",
     "merge_summaries",
+    "parse_slo_objectives",
     "profiler_trace",
     "read_jsonl",
+    "serve_metrics",
     "summarize_phases",
     "summary_from_json",
     "summary_to_json",

@@ -22,7 +22,10 @@ conventional ones used by the engine:
 - ``runner.runs`` / ``runner.analyzer_failures``
 - ``repository.saves`` / ``repository.loads``
 - ``checks.evaluated``
-- ``retries``                   reserved for transport/IO retry wiring
+
+Every registered name must have a catalog row in docs/OBSERVABILITY.md
+(and vice versa) — the ``metric-docs`` staticcheck rule enforces the
+pairing in both directions.
 """
 
 from __future__ import annotations

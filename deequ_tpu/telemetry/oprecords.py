@@ -112,3 +112,30 @@ def operational_metrics(
         )
         for name, value in operational_values(summary).items()
     }
+
+
+def slo_metrics(
+    snapshot: Optional[Dict[str, Any]],
+) -> Dict[Analyzer, Metric]:
+    """Flatten an ``SloTracker.snapshot()`` into repository-persistable
+    operational records: per class ``slo.class.<name>.attained`` and
+    ``.budget_burn`` (per tenant under ``slo.tenant.<name>.*``) — so
+    the anomaly strategies can alert on p99 drift from the SAME metric
+    series machinery as everything else."""
+    if not snapshot:
+        return {}
+    out: Dict[Analyzer, Metric] = {}
+    for scope, key in (("class", "classes"), ("tenant", "tenants")):
+        for name, stats in (snapshot.get(key) or {}).items():
+            for field in ("attained", "budget_burn"):
+                value = stats.get(field)
+                if value is None or value != value or value in (
+                    float("inf"), float("-inf")
+                ):
+                    continue
+                instance = f"slo.{scope}.{name}.{field}"
+                out[OperationalAnalyzer(instance)] = DoubleMetric(
+                    Entity.DATASET, "Operational", instance,
+                    Success(float(value)),
+                )
+    return out

@@ -35,9 +35,14 @@ from deequ_tpu.telemetry.spans import (
     NOOP_SPAN,
     NOOP_SPAN_CM,
     Span,
+    TraceContext,
     Tracer,
     clock,
+    epoch,
+    next_span_id,
 )
+
+_NOOP_SCOPE = contextlib.nullcontext(None)
 
 _run_ids = itertools.count(1)
 _UNSET = object()
@@ -116,6 +121,15 @@ class Telemetry:
         self.enabled = bool(enabled)
         self.metrics = MetricsRegistry()
         self.tracer = Tracer(annotate=annotate)
+        # fleet-timeline tag for every span record this process emits
+        # (set per-host in the distributed service, per-child in spawn
+        # children); empty = untagged
+        self.process_label = os.environ.get(
+            "DEEQU_TPU_TELEMETRY_PROCESS", ""
+        )
+        # best-effort callbacks fed every finished span RECORD (the
+        # spawn boundary streams child spans to the parent through one)
+        self._span_sinks: List[Any] = []
         self._listeners: List[RunListener] = []
         self._local = threading.local()
         self._jsonl_path = jsonl_path
@@ -132,6 +146,7 @@ class Telemetry:
         enabled: Optional[bool] = None,
         jsonl_path: Any = _UNSET,
         annotate: Optional[bool] = None,
+        process: Optional[str] = None,
     ) -> "Telemetry":
         if enabled is not None:
             self.enabled = bool(enabled)
@@ -139,6 +154,8 @@ class Telemetry:
             self._jsonl_path = jsonl_path
         if annotate is not None:
             self.tracer.annotate = bool(annotate)
+        if process is not None:
+            self.process_label = process
         return self
 
     @property
@@ -226,7 +243,14 @@ class Telemetry:
         )
 
     def _on_span_finish(self, sp: Span) -> None:
-        record = sp.as_record()
+        self._ingest_record(sp.as_record())
+
+    def _ingest_record(self, record: Dict[str, Any]) -> None:
+        """Route one finished span RECORD to captures, the recent ring,
+        span sinks, and the JSONL log — live and replayed spans share
+        this path."""
+        if self.process_label and not record.get("process"):
+            record["process"] = self.process_label
         captures = self._captures()
         if captures:
             record["run_id"] = captures[-1].run_id
@@ -234,7 +258,129 @@ class Telemetry:
                 cap.spans.append(record)
         with self._recent_lock:
             self._recent.append(record)
+        for sink in self._span_sinks:
+            try:
+                sink(record)
+            except Exception:  # noqa: BLE001 — a broken sink must never
+                # fail a run (same contract as listeners)
+                self.metrics.counter("telemetry.listener_errors").inc()
         self._write_jsonl(record)
+
+    # -- trace propagation ----------------------------------------------
+
+    def trace_scope(self, ctx: Optional[TraceContext]):
+        """Make ``ctx`` the ambient trace on this thread (no-op when
+        telemetry is disabled or ``ctx`` is None — the zero-cost-off
+        path allocates nothing)."""
+        if not self.enabled or ctx is None:
+            return _NOOP_SCOPE
+        return self.tracer.trace_scope(ctx)
+
+    def current_trace(self) -> Optional[TraceContext]:
+        if not self.enabled:
+            return None
+        return self.tracer.current_trace()
+
+    def add_span_sink(self, sink: Any) -> Any:
+        self._span_sinks.append(sink)
+        return sink
+
+    def remove_span_sink(self, sink: Any) -> None:
+        try:
+            self._span_sinks.remove(sink)
+        except ValueError:
+            pass
+
+    def emit_span(
+        self,
+        name: str,
+        wall_s: float = 0.0,
+        *,
+        trace: Optional[TraceContext] = None,
+        span_id: Optional[int] = None,
+        parent_id: Optional[int] = None,
+        started_at: Optional[float] = None,
+        **attributes: Any,
+    ) -> Optional[Dict[str, Any]]:
+        """Record a span that was MEASURED rather than lived-through: a
+        queue wait read off ticket timestamps, a lease wait, a phase
+        bucket. Parent resolution: explicit ``parent_id`` > current
+        open span on this thread > ambient trace root > None. Pass
+        ``span_id=trace.span_id`` (with ``parent_id=None``) to emit the
+        trace's reserved root."""
+        if not self.enabled:
+            return None
+        ctx = trace if trace is not None else self.tracer.current_trace()
+        sid = span_id if span_id is not None else next_span_id()
+        if parent_id is None and span_id is None:
+            current = self.tracer.current()
+            if current is not None:
+                parent_id = current.span_id
+            elif ctx is not None:
+                parent_id = ctx.span_id
+        sp = Span(
+            name=name,
+            span_id=sid,
+            parent_id=parent_id,
+            thread=threading.current_thread().name,
+            started_at=(
+                started_at if started_at is not None
+                else epoch() - max(0.0, wall_s)
+            ),
+            wall_s=max(0.0, float(wall_s)),
+            attributes=dict(attributes),
+            trace_id=ctx.trace_id if ctx is not None else None,
+            process=ctx.process if ctx is not None else "",
+        )
+        record = sp.as_record()
+        self._ingest_record(record)
+        return record
+
+    def replay_spans(
+        self,
+        records: List[Dict[str, Any]],
+        *,
+        root_parent_id: Optional[int] = None,
+        trace_id: Optional[str] = None,
+        process: str = "",
+    ) -> List[Dict[str, Any]]:
+        """Re-ingest span records produced by ANOTHER process (a spawn
+        child): span ids are remapped onto this process's counter so
+        they cannot collide, internal parentage is preserved, and any
+        record whose parent is unknown re-roots under
+        ``root_parent_id``. Returns the re-ingested records."""
+        if not self.enabled or not records:
+            return []
+        id_map = {
+            r["span_id"]: next_span_id()
+            for r in records
+            if isinstance(r.get("span_id"), int)
+        }
+        out: List[Dict[str, Any]] = []
+        for r in records:
+            if not isinstance(r, dict) or r.get("type") != "span":
+                continue
+            rec = dict(r)
+            rec["span_id"] = id_map.get(rec.get("span_id"), next_span_id())
+            parent = rec.get("parent_id")
+            # the anchor check comes FIRST: the child's local id counter
+            # can collide with the shipped parent id, and a span that
+            # parents to the anchor must stay on it, not follow the
+            # colliding child id through the remap
+            if parent == root_parent_id and parent is not None:
+                pass  # already anchored on the shipped parent span
+            elif parent in id_map:
+                rec["parent_id"] = id_map[parent]
+            else:
+                rec["parent_id"] = root_parent_id
+            if trace_id is not None:
+                rec["trace_id"] = trace_id
+            if process and not rec.get("process"):
+                rec["process"] = process
+            rec.pop("run_id", None)  # re-attributed by _ingest_record
+            self._ingest_record(rec)
+            out.append(rec)
+        return out
 
     @contextlib.contextmanager
     def pass_span(
@@ -354,10 +500,14 @@ def configure(
     enabled: Optional[bool] = None,
     jsonl_path: Any = _UNSET,
     annotate: Optional[bool] = None,
+    process: Optional[str] = None,
 ) -> Telemetry:
     """Configure the process-default instance (see
     ``Telemetry.configure``)."""
     with _default_lock:
         return _default.configure(
-            enabled=enabled, jsonl_path=jsonl_path, annotate=annotate
+            enabled=enabled,
+            jsonl_path=jsonl_path,
+            annotate=annotate,
+            process=process,
         )

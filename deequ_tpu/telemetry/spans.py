@@ -20,16 +20,72 @@ import contextlib
 import itertools
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, Optional
 
 _span_ids = itertools.count(1)
 
 
+def next_span_id() -> int:
+    """A fresh process-unique span id (synthetic spans, replay remaps)."""
+    return next(_span_ids)
+
+
 def clock() -> float:
     """Monotonic seconds — the sanctioned timing source for callers
     outside the telemetry layer (see tools/telemetry_lint.py)."""
     return time.perf_counter()
+
+
+def epoch() -> float:
+    """Epoch seconds — the sanctioned wall-clock source (span
+    ``started_at`` ordering; service layers use injected clocks and
+    never call this directly)."""
+    return time.time()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The identity a run's spans share across threads and processes.
+
+    ``trace_id`` names the run; ``span_id`` is the id RESERVED for the
+    run's synthetic root span (emitted at terminal), so spans started
+    anywhere under this context parent to the root before the root
+    itself exists. ``process`` tags spans for fleet-timeline merges of
+    per-host JSONL artifacts."""
+
+    trace_id: str
+    span_id: int
+    process: str = ""
+
+    @classmethod
+    def mint(cls, seed: str = "", process: str = "") -> "TraceContext":
+        suffix = uuid.uuid4().hex[:8]
+        trace_id = f"{seed}-{suffix}" if seed else suffix
+        return cls(trace_id=trace_id, span_id=next(_span_ids),
+                   process=process)
+
+    def child(self, span_id: int) -> "TraceContext":
+        """The same trace re-anchored under ``span_id`` (what crosses
+        the spawn boundary: the child's roots parent here)."""
+        return TraceContext(trace_id=self.trace_id, span_id=span_id,
+                            process=self.process)
+
+    def encode(self) -> str:
+        return f"{self.trace_id}:{self.span_id}:{self.process}"
+
+    @classmethod
+    def decode(cls, text: str) -> Optional["TraceContext"]:
+        parts = text.split(":", 2)
+        if len(parts) < 2:
+            return None
+        try:
+            span_id = int(parts[1])
+        except ValueError:
+            return None
+        return cls(trace_id=parts[0], span_id=span_id,
+                   process=parts[2] if len(parts) > 2 else "")
 
 
 @dataclass
@@ -41,13 +97,15 @@ class Span:
     started_at: float  # epoch seconds (export ordering across threads)
     wall_s: float = 0.0
     attributes: Dict[str, Any] = field(default_factory=dict)
+    trace_id: Optional[str] = None
+    process: str = ""
 
     def set(self, **attrs: Any) -> "Span":
         self.attributes.update(attrs)
         return self
 
     def as_record(self) -> Dict[str, Any]:
-        return {
+        record = {
             "type": "span",
             "name": self.name,
             "span_id": self.span_id,
@@ -57,6 +115,13 @@ class Span:
             "wall_s": round(self.wall_s, 6),
             "attributes": dict(self.attributes),
         }
+        # trace identity only when a TraceContext was ambient — untraced
+        # runs keep the classic record shape byte-for-byte
+        if self.trace_id is not None:
+            record["trace_id"] = self.trace_id
+            if self.process:
+                record["process"] = self.process
+        return record
 
 
 class _NoopSpan:
@@ -105,6 +170,21 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def current_trace(self) -> Optional[TraceContext]:
+        return getattr(self._local, "trace", None)
+
+    @contextlib.contextmanager
+    def trace_scope(self, ctx: Optional[TraceContext]) -> Iterator[None]:
+        """Make ``ctx`` the ambient trace on this thread: spans started
+        with an empty stack parent to ``ctx.span_id`` and every span
+        carries ``ctx.trace_id`` until the scope exits."""
+        prev = getattr(self._local, "trace", None)
+        self._local.trace = ctx
+        try:
+            yield
+        finally:
+            self._local.trace = prev
+
     @contextlib.contextmanager
     def span(
         self,
@@ -113,13 +193,20 @@ class Tracer:
         **attributes: Any,
     ) -> Iterator[Span]:
         stack = self._stack()
+        ctx = getattr(self._local, "trace", None)
         sp = Span(
             name=name,
             span_id=next(_span_ids),
-            parent_id=stack[-1].span_id if stack else None,
+            parent_id=(
+                stack[-1].span_id
+                if stack
+                else (ctx.span_id if ctx is not None else None)
+            ),
             thread=threading.current_thread().name,
             started_at=time.time(),
             attributes=dict(attributes),
+            trace_id=ctx.trace_id if ctx is not None else None,
+            process=ctx.process if ctx is not None else "",
         )
         stack.append(sp)
         annotation = _trace_annotation(name) if self.annotate else None

@@ -722,3 +722,292 @@ class TestObsReportServiceSection:
         from tools.obs_report import render_service
 
         assert render_service([{"type": "span"}]) == ""
+
+
+# --------------------------------------------------------------------------
+# end-to-end run tracing: the service-side span tree (docs/OBSERVABILITY.md)
+# --------------------------------------------------------------------------
+
+
+def _trace_table():
+    """Module-level dataset factory: pickles by reference, so traced
+    requests survive the spawn boundary under ``isolated=True``."""
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(29)
+    return Dataset.from_pydict(
+        {
+            "a": rng.integers(0, 50, 2_000, dtype=np.int64).tolist(),
+            "b": rng.normal(5.0, 2.0, 2_000).tolist(),
+        }
+    )
+
+
+class _TraceSink:
+    """Capture every finished span record on the process telemetry."""
+
+    def __init__(self):
+        from deequ_tpu.telemetry import get_telemetry
+
+        self.records = []
+        self._tm = get_telemetry()
+
+    def __enter__(self):
+        self._tm.add_span_sink(self.records.append)
+        return self.records
+
+    def __exit__(self, *exc):
+        self._tm.remove_span_sink(self.records.append)
+
+
+def _trace_tree(records, trace_id):
+    """(spans, root) of one trace; asserts it is a SINGLE connected
+    tree — every span reaches one root."""
+    spans = [r for r in records if r.get("trace_id") == trace_id]
+    assert spans, f"no spans for trace {trace_id}"
+    ids = {r["span_id"] for r in spans}
+    roots = [r for r in spans if r.get("parent_id") not in ids]
+    assert len(roots) == 1, [(r["name"], r["parent_id"]) for r in roots]
+    return spans, roots[0]
+
+
+class TestRunTracing:
+    def _trace_of(self, records, handle):
+        ids = {
+            r["trace_id"]
+            for r in records
+            if r.get("trace_id", "").startswith(handle.run_id + "-")
+        }
+        assert len(ids) == 1, (handle.run_id, ids)
+        return ids.pop()
+
+    def test_worker_run_one_tree_stages_sum_to_wall(self):
+        """The differential pin: a scheduler-worker run yields one
+        connected tree under one trace_id, and the critical-path stage
+        decomposition sums to the root wall within 5% on ManualClock."""
+        from tools.trace_report import STAGES, _Tree, decompose, load_traces
+
+        clock = ManualClock()
+
+        def execute(ticket):
+            clock.advance(3.0)
+            return _FakeResult()
+
+        svc = VerificationService(
+            workers=1, clock=clock, execute=execute,
+            tenant_max_pending=0, tenant_max_active=0, trace=True,
+        ).start()
+        try:
+            with _TraceSink() as records:
+                handle = svc.submit(
+                    RunRequest(
+                        tenant="acme", checks=(), dataset_key="d",
+                        dataset_factory=lambda: None,
+                        priority=Priority.STANDARD,
+                    )
+                )
+                assert _spin_until(lambda: handle.done)
+                assert _spin_until(
+                    lambda: any(
+                        r["name"] == "ticket" for r in records
+                    )
+                )
+        finally:
+            svc.stop(drain=False, timeout=30)
+        trace_id = self._trace_of(records, handle)
+        spans, root = _trace_tree(records, trace_id)
+        assert root["name"] == "ticket"
+        names = {r["name"] for r in spans}
+        assert {"queue_wait", "execute"} <= names
+        trees = {
+            tid: _Tree(sp) for tid, sp in load_traces(records).items()
+        }
+        decomp = decompose(trace_id, trees)
+        assert decomp["wall_s"] >= 3.0
+        assert set(decomp["stages"]) <= set(STAGES)
+        total = sum(decomp["stages"].values())
+        assert abs(total - decomp["wall_s"]) <= 0.05 * decomp["wall_s"]
+
+    def test_coalesced_group_member_traces_link_to_host(self):
+        """Each member of a coalesced group gets its OWN connected
+        tree; non-host members carry a ``coalesced_scan`` link span
+        pointing into the host's execute span."""
+        from deequ_tpu.analyzers import Completeness, Mean
+
+        svc = VerificationService(
+            workers=1, coalesce=True, coalesce_window_s=0.0, trace=True,
+        )
+        with _TraceSink() as records:
+            handles = [
+                svc.submit(
+                    RunRequest(
+                        tenant=f"t{i}",
+                        checks=(),
+                        required_analyzers=[Completeness("a"), Mean("b")],
+                        dataset_key="shared/traced",
+                        dataset_factory=_trace_table,
+                        priority=Priority.BATCH,
+                    )
+                )
+                for i in range(3)
+            ]
+            svc.start()
+            try:
+                results = [h.result(timeout=300) for h in handles]
+            finally:
+                svc.stop(drain=False, timeout=30)
+        trace_ids = [self._trace_of(records, h) for h in handles]
+        assert len(set(trace_ids)) == 3
+        link_targets = []
+        execute_traces = []
+        for trace_id in trace_ids:
+            spans, root = _trace_tree(records, trace_id)
+            assert root["name"] == "ticket"
+            names = {r["name"] for r in spans}
+            if "execute" in names:
+                execute_traces.append(trace_id)
+            for r in spans:
+                if r["name"] == "coalesced_scan":
+                    attrs = r.get("attributes") or {}
+                    link_targets.append(
+                        (attrs.get("link_trace_id"),
+                         attrs.get("link_span_id"))
+                    )
+        # ONE host ran the superset scan; the other two link into it
+        assert len(execute_traces) == 1
+        host_trace = execute_traces[0]
+        host_spans, _ = _trace_tree(records, host_trace)
+        host_execute = next(
+            r for r in host_spans if r["name"] == "execute"
+        )
+        assert len(link_targets) == 2
+        assert all(
+            target == (host_trace, host_execute["span_id"])
+            for target in link_targets
+        )
+        # the host tree carries the real engine spans
+        host_names = {r["name"] for r in host_spans}
+        assert "run:coalesced_analysis" in host_names or any(
+            n.startswith("run:") for n in host_names
+        )
+        assert any(n.startswith("pass:") for n in host_names)
+        # every member's sliced result is scoped to its own trace
+        for handle, result, trace_id in zip(handles, results, trace_ids):
+            assert result.telemetry["trace_id"] == trace_id
+
+    def test_isolated_run_replays_child_spans_into_tree(self):
+        """A spawn-child run is still ONE connected tree: the child's
+        spans stream back, re-root under the parent's launch span, and
+        carry the child process tag."""
+        from deequ_tpu.analyzers import Completeness, Mean
+
+        svc = VerificationService(
+            workers=1, isolated=True, coalesce=False, trace=True,
+        )
+        with _TraceSink() as records:
+            handle = svc.submit(
+                RunRequest(
+                    tenant="acme",
+                    checks=(),
+                    required_analyzers=[Completeness("a"), Mean("b")],
+                    dataset_key="iso/traced",
+                    dataset_factory=_trace_table,
+                    priority=Priority.STANDARD,
+                )
+            )
+            svc.start()
+            try:
+                result = handle.result(timeout=300)
+            finally:
+                svc.stop(drain=False, timeout=30)
+        assert result.telemetry is not None
+        trace_id = self._trace_of(records, handle)
+        spans, root = _trace_tree(records, trace_id)
+        assert root["name"] == "ticket"
+        child_spans = [r for r in spans if r.get("process") == "child"]
+        assert child_spans, "no child-process spans replayed"
+        assert any(
+            r["name"].startswith("run:") for r in child_spans
+        )
+
+    def test_endpoints_live_while_running(self):
+        """/metrics and /healthz answer DURING a run — stdlib urllib,
+        ephemeral port, no new deps."""
+        import json as _json
+        import urllib.request
+
+        started = threading.Event()
+        release = threading.Event()
+
+        def execute(ticket):
+            started.set()
+            release.wait(10)
+            return _FakeResult()
+
+        svc = VerificationService(
+            workers=1, clock=ManualClock(), execute=execute,
+            tenant_max_pending=0, tenant_max_active=0,
+            trace=True, metrics_port=0,
+            slo_objectives="interactive=1.0,standard=5.0",
+        ).start()
+        try:
+            assert svc.metrics_server is not None
+            assert svc.metrics_server.port > 0
+            handle = svc.submit(
+                RunRequest(
+                    tenant="acme", checks=(), dataset_key="d",
+                    dataset_factory=lambda: None,
+                    priority=Priority.STANDARD,
+                )
+            )
+            assert started.wait(10)
+            base = svc.metrics_server.url
+            metrics = urllib.request.urlopen(
+                base + "/metrics", timeout=10
+            ).read().decode()
+            assert "deequ_tpu_service_submitted" in metrics
+            health = _json.loads(
+                urllib.request.urlopen(
+                    base + "/healthz", timeout=10
+                ).read().decode()
+            )
+            assert health["status"] == "ok"
+            assert health["workers"] >= 1
+            assert "queue" in health and "breakers" in health
+            assert "shed" in health
+            assert set(health["slo"]["classes"]) == {
+                "interactive", "standard",
+            }
+            release.set()
+            assert _spin_until(lambda: handle.done)
+        finally:
+            release.set()
+            svc.stop(drain=False, timeout=30)
+        # the endpoint dies with the service — no leaked thread
+        assert svc.metrics_server is None
+
+    def test_zero_cost_when_trace_and_port_off(self):
+        """Default config: no endpoint thread, no TraceContext, no span
+        records at all from a stub service run."""
+        def execute(ticket):
+            return _FakeResult()
+
+        svc = VerificationService(
+            workers=1, clock=ManualClock(), execute=execute,
+            tenant_max_pending=0, tenant_max_active=0,
+        ).start()
+        try:
+            assert svc.metrics_server is None
+            with _TraceSink() as records:
+                handle = svc.submit(
+                    RunRequest(
+                        tenant="acme", checks=(), dataset_key="d",
+                        dataset_factory=lambda: None,
+                        priority=Priority.STANDARD,
+                    )
+                )
+                assert _spin_until(lambda: handle.done)
+                svc.wait_idle(timeout=10)
+        finally:
+            svc.stop(drain=False, timeout=30)
+        assert records == []

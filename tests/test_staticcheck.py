@@ -1346,3 +1346,134 @@ class TestSubprocessDiscipline:
             if f.rule == "subprocess-discipline"
         ]
         assert found == []
+
+
+# --------------------------------------------------------------------------
+# metric-docs: registered metrics <-> docs catalog contract
+# --------------------------------------------------------------------------
+
+METRIC_REGISTRATIONS = """
+    class _M:
+        def counter(self, name):
+            return 0
+
+        def gauge(self, name):
+            return 0
+
+        def histogram(self, name, value):
+            return 0
+
+
+    def _bump(name, n=1):
+        pass
+
+
+    def work(m, label):
+        m.counter("engine.widgets")
+        m.gauge("engine.widget_depth")
+        m.histogram("engine.widget_wall_s", 0.5)
+        m.counter(f"engine.widgets.per_shape.{label}.hits")
+        _bump("repository.widget_saves")
+        m.counter("not a metric")  # spaces: ignored
+        m.counter("plainword")  # no dot: ignored
+"""
+
+METRIC_CATALOG_COMPLETE = """\
+# Observability
+
+## Metric catalog
+
+| metric | type | meaning |
+|---|---|---|
+| `engine.widgets` | c | widgets |
+| `engine.widget_depth` | g | depth |
+| `engine.widget_wall_s` | h | wall |
+| `engine.widgets.per_shape.<label>.hits` | c | per-shape family |
+| `repository.widget_saves` | c | wrapper-registered |
+
+## Next section
+
+| `engine.outside_catalog` | c | rows outside the section are ignored |
+"""
+
+
+class TestMetricDocs:
+    def _docs(self, tmp_path, text):
+        docs = tmp_path / "docs"
+        docs.mkdir(exist_ok=True)
+        (docs / "OBSERVABILITY.md").write_text(text)
+
+    def test_silent_when_catalog_matches(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/fixture.py", METRIC_REGISTRATIONS)
+        self._docs(tmp_path, METRIC_CATALOG_COMPLETE)
+        assert _rules_found(tmp_path, "metric-docs") == []
+
+    def test_catches_registered_but_undocumented(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/fixture.py", METRIC_REGISTRATIONS)
+        self._docs(
+            tmp_path,
+            METRIC_CATALOG_COMPLETE.replace(
+                "| `engine.widget_depth` | g | depth |\n", ""
+            ),
+        )
+        found = _rules_found(tmp_path, "metric-docs")
+        assert len(found) == 1
+        assert found[0].symbol == "engine.widget_depth"
+        assert found[0].path == "deequ_tpu/fixture.py"
+        assert found[0].line > 0
+
+    def test_catches_stale_catalog_row(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/fixture.py", METRIC_REGISTRATIONS)
+        self._docs(
+            tmp_path,
+            METRIC_CATALOG_COMPLETE.replace(
+                "\n## Next section",
+                "| `engine.retired_metric` | c | long gone |\n"
+                "\n## Next section",
+            ),
+        )
+        found = _rules_found(tmp_path, "metric-docs")
+        assert len(found) == 1
+        assert found[0].symbol == "engine.retired_metric"
+        assert found[0].path == "docs/OBSERVABILITY.md"
+
+    def test_fstring_holes_match_placeholder_rows(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/fixture.py",
+            """
+            def work(m, tenant):
+                m.counter(f"service.tenant.{tenant}.runs")
+            """,
+        )
+        self._docs(
+            tmp_path,
+            "## Metric catalog\n\n"
+            "| `service.tenant.<tenant>.runs` | c | per-tenant |\n",
+        )
+        assert _rules_found(tmp_path, "metric-docs") == []
+
+    def test_missing_docs_flags_only_with_registrations(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/fixture.py",
+            """
+            def work(m):
+                m.counter("engine.widgets")
+            """,
+        )
+        found = _rules_found(tmp_path, "metric-docs")
+        assert len(found) == 1
+        assert "missing" in found[0].message
+
+    def test_silent_on_fixture_roots_without_metrics(self, tmp_path):
+        _write(tmp_path, "deequ_tpu/fixture.py", "x = 1\n")
+        assert _rules_found(tmp_path, "metric-docs") == []
+
+    def test_shipped_tree_catalog_is_in_sync(self):
+        found = [
+            f
+            for f in unwaived(run_analyzers(REPO_ROOT))
+            if f.rule == "metric-docs"
+        ]
+        assert found == []

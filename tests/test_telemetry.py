@@ -621,3 +621,268 @@ class TestTools:
             if v[0].startswith("deequ_tpu/service/")
         ]
         assert service == []
+
+
+# --------------------------------------------------------------------------
+# end-to-end run tracing (docs/OBSERVABILITY.md "Tracing")
+# --------------------------------------------------------------------------
+
+
+def _traced_child(payload):
+    """Spawn-child entry point (module level: pickled by reference)."""
+    from deequ_tpu.telemetry import get_telemetry
+
+    with get_telemetry().span("child_work"):
+        return payload
+
+
+def _traced_crash_child(payload):
+    """Emits one span (streamed back over the pipe), then dies hard —
+    the parent must still know where the child got to."""
+    import signal
+
+    from deequ_tpu.telemetry import get_telemetry
+    from deequ_tpu.testing.faults import hard_crash
+
+    with get_telemetry().span("doomed_stage"):
+        pass
+    hard_crash(signal.SIGSEGV)
+
+
+class _SpanSink:
+    """Capture every finished span record on the process telemetry."""
+
+    def __init__(self):
+        self.records = []
+        self._tm = get_telemetry()
+
+    def __enter__(self):
+        self._tm.add_span_sink(self.records.append)
+        return self.records
+
+    def __exit__(self, *exc):
+        self._tm.remove_span_sink(self.records.append)
+
+
+def _assert_single_connected_tree(records, trace_id):
+    """Every span of the trace reaches ONE root (the synthetic
+    ``ticket`` root or the context's reserved root id)."""
+    spans = [r for r in records if r.get("trace_id") == trace_id]
+    assert spans, f"no spans for trace {trace_id}"
+    ids = {r["span_id"] for r in spans}
+    roots = [r for r in spans if r.get("parent_id") not in ids]
+    assert len(roots) == 1, [(r["name"], r["parent_id"]) for r in roots]
+    return spans, roots[0]
+
+
+class TestRunTracing:
+    def test_trace_context_roundtrip(self):
+        from deequ_tpu.telemetry import TraceContext
+
+        ctx = TraceContext.mint("run-7", process="host-a")
+        assert ctx.trace_id.startswith("run-7-")
+        back = TraceContext.decode(ctx.child(123).encode())
+        assert back == TraceContext(ctx.trace_id, 123, process="host-a")
+        assert TraceContext.decode("garbage") is None
+        assert TraceContext.decode("t:notanint:p") is None
+
+    def test_spawn_child_spans_reroot_connected(self):
+        """A span emitted INSIDE the spawn child streams back and lands
+        under the parent's launching span — one connected tree, child
+        spans process-tagged for the fleet timeline."""
+        from deequ_tpu.engine.subproc import IsolatedRunner
+        from deequ_tpu.telemetry import TraceContext
+
+        tm = get_telemetry()
+        ctx = TraceContext.mint("iso-run")
+        with _SpanSink() as records:
+            with tm.trace_scope(ctx):
+                with tm.span("lease_wait"):
+                    out = IsolatedRunner(key="trace-ok", use_breaker=False).run(
+                        _traced_child, {"x": 1}
+                    )
+            tm.emit_span(
+                "ticket", 0.5, trace=ctx, span_id=ctx.span_id, parent_id=None
+            )
+        assert out == {"x": 1}
+        spans, root = _assert_single_connected_tree(records, ctx.trace_id)
+        assert root["name"] == "ticket"
+        by_name = {r["name"]: r for r in spans}
+        assert by_name["lease_wait"]["parent_id"] == root["span_id"]
+        child = by_name["child_work"]
+        assert child["process"] == "child"
+        # the child's run span parents under the parent's lease span
+        run_span = by_name["run:isolated_child"]
+        assert run_span["parent_id"] == by_name["lease_wait"]["span_id"]
+        assert child["parent_id"] in {r["span_id"] for r in spans}
+
+    def test_crashed_child_streams_spans_before_death(self):
+        """Satellite pin: spans that arrived before a SIGSEGV are
+        replayed into the parent's tree — trace_report can show where
+        the run died."""
+        from deequ_tpu.engine.subproc import CrashLoopError, IsolatedRunner
+        from deequ_tpu.telemetry import TraceContext
+
+        tm = get_telemetry()
+        ctx = TraceContext.mint("crash-run")
+        with _SpanSink() as records:
+            with tm.trace_scope(ctx):
+                with tm.span("lease_wait"):
+                    with pytest.raises(CrashLoopError):
+                        IsolatedRunner(
+                            key="trace-crash",
+                            max_relaunches=1,
+                            use_breaker=False,
+                        ).run(_traced_crash_child, {})
+            tm.emit_span(
+                "ticket", 0.5, trace=ctx, span_id=ctx.span_id, parent_id=None
+            )
+        spans, root = _assert_single_connected_tree(records, ctx.trace_id)
+        doomed = [r for r in spans if r["name"] == "doomed_stage"]
+        assert len(doomed) == 1
+        assert doomed[0]["process"] == "child"
+
+    def test_member_provenance_under_coalescing(self):
+        """Each coalesced member's sliced result carries telemetry
+        scoped to its OWN trace_id — summary and every span record."""
+        from deequ_tpu.checks.check import Check, CheckLevel
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.service import (
+            Priority,
+            RunRequest,
+            VerificationService,
+        )
+
+        def _suite(i):
+            check = Check(CheckLevel.ERROR, f"tenant-{i}").is_complete(
+                "att1"
+            )
+            if i % 2 == 0:
+                check = check.is_complete("att2")
+            return [check]
+
+        svc = VerificationService(
+            workers=1,
+            coalesce=True,
+            coalesce_window_s=0.0,
+            trace=True,
+        )
+        handles = [
+            svc.submit(
+                RunRequest(
+                    tenant=f"t{i}",
+                    checks=_suite(i),
+                    dataset_key="shared/trace-prov",
+                    dataset_factory=df_numeric,
+                    priority=Priority.BATCH,
+                )
+            )
+            for i in range(3)
+        ]
+        svc.start()
+        try:
+            results = [h.result(timeout=300) for h in handles]
+        finally:
+            svc.stop(drain=False, timeout=30)
+        for handle, result in zip(handles, results):
+            summary = result.telemetry
+            assert summary is not None
+            trace_id = summary["trace_id"]
+            assert trace_id.startswith(handle.run_id + "-")
+            assert summary["spans"], "member summary lost its spans"
+            assert all(
+                sp["trace_id"] == trace_id for sp in summary["spans"]
+            )
+        # three members, three distinct trace identities over ONE scan
+        assert len({r.telemetry["trace_id"] for r in results}) == 3
+
+
+class TestTracingZeroCost:
+    def test_trace_scope_is_shared_noop_when_disabled(self):
+        from deequ_tpu.telemetry import TraceContext
+
+        tm = Telemetry(enabled=False, annotate=False)
+        ctx = TraceContext.mint("x")
+        assert tm.trace_scope(ctx) is tm.trace_scope(None)
+        assert tm.current_trace() is None
+
+    def test_untraced_run_emits_no_trace_spans(self):
+        """Without an ambient TraceContext the engine emits exactly the
+        classic span set — no phase/persist/egress spans, no trace_id
+        tagging — so tracing-off costs nothing beyond PhaseClock."""
+        with _SpanSink() as records:
+            AnalysisRunner.do_analysis_run(
+                df_numeric(), [Size(), Mean("att1")]
+            )
+        assert records
+        names = {r["name"] for r in records}
+        assert not any(n.startswith("phase:") for n in names)
+        assert "persist" not in names and "egress" not in names
+        assert all(r.get("trace_id") is None for r in records)
+
+
+class TestTraceReportTool:
+    def _span(self, trace, sid, parent, name, wall, start=0.0, **attrs):
+        return {
+            "type": "span", "trace_id": trace, "span_id": sid,
+            "parent_id": parent, "name": name, "wall_s": wall,
+            "started_at": start, "thread": "t", "attributes": attrs,
+        }
+
+    def _records(self):
+        return [
+            # slow run: queue-bound (8s of 10s in queue_wait)
+            self._span("A", 1, None, "ticket", 10.0, run_id="run-a"),
+            self._span("A", 2, 1, "queue_wait", 8.0),
+            self._span("A", 3, 1, "execute", 2.0, start=8.0),
+            # fast run: execute-bound
+            self._span("B", 4, None, "ticket", 4.0, run_id="run-b"),
+            self._span("B", 5, 4, "queue_wait", 1.0),
+            self._span("B", 6, 4, "execute", 3.0, start=1.0),
+        ]
+
+    def test_aggregate_names_dominant_p99_stage(self):
+        from tools.trace_report import (
+            _Tree,
+            aggregate,
+            decompose,
+            load_traces,
+        )
+
+        traces = load_traces(self._records())
+        trees = {tid: _Tree(sp) for tid, sp in traces.items()}
+        decomps = [decompose(tid, trees) for tid in traces]
+        agg = aggregate(decomps)
+        assert agg["runs"] == 2
+        # p99 is the queue-bound run; the report must blame the queue
+        assert agg["p99"]["wall_s"] == 10.0
+        assert agg["p99"]["dominant_stage"] == "queue_wait"
+        assert agg["p50"]["dominant_stage"] == "finalize"
+        # per-run stages sum to the root wall exactly
+        for d in decomps:
+            assert abs(sum(d["stages"].values()) - d["wall_s"]) < 1e-9
+
+    def test_render_waterfall_and_run_filter(self):
+        from tools.trace_report import render
+
+        out = render(self._records())
+        assert "ticket" in out and "queue_wait" in out
+        assert "aggregate over 2 traced run(s):" in out
+        assert "dominant stage: queue_wait" in out
+        only_a = render(self._records(), run="run-a")
+        assert "run-b" not in only_a
+        assert render([], run=None).startswith("no traced spans")
+
+    def test_obs_report_all_and_trace_passthrough(self, tmp_path, capsys):
+        from tools.obs_report import main as report_main
+
+        path = tmp_path / "runs.jsonl"
+        with path.open("w") as fh:
+            for rec in self._records():
+                fh.write(json.dumps(rec) + "\n")
+        assert report_main([str(path), "--all"]) == 0
+        out = capsys.readouterr().out
+        assert "aggregate over 2 traced run(s):" in out
+        assert report_main([str(path), "--trace", "run-b"]) == 0
+        out = capsys.readouterr().out
+        assert "run-a" not in out

@@ -5,6 +5,8 @@ Usage:
     python -m tools.obs_report runs.jsonl            # all runs
     python -m tools.obs_report runs.jsonl --run 3    # one run
     python -m tools.obs_report runs.jsonl --counters # counter totals only
+    python -m tools.obs_report runs.jsonl --all      # every section
+    python -m tools.obs_report runs.jsonl --trace X  # tools.trace_report
     python -m tools.obs_report --staticcheck         # lint health line
 
 The artifact is produced by ``deequ_tpu.telemetry.configure(
@@ -661,6 +663,23 @@ def render_staticcheck(root: Optional[str] = None) -> str:
     )
 
 
+def render_all(records: List[Dict[str, Any]]) -> str:
+    """Every section in one report: run breakdowns with all the
+    optional sections, counter totals, the trace critical-path
+    aggregate (tools.trace_report), and the staticcheck health line."""
+    parts = [render(records)]
+    counters = render(records, counters_only=True)
+    if counters:
+        parts.append(counters)
+    from tools.trace_report import render as render_traces
+
+    traces = render_traces(records)
+    if not traces.startswith("no traced spans"):
+        parts.append(traces)
+    parts.append(render_staticcheck())
+    return "\n\n".join(p for p in parts if p)
+
+
 def render(
     records: List[Dict[str, Any]],
     run_id: Optional[int] = None,
@@ -750,6 +769,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="append the one-line static-analysis summary "
         "(tools.staticcheck); usable without a JSONL path",
     )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="print every section: run breakdowns, counter totals, "
+        "the trace critical-path aggregate, and the staticcheck line",
+    )
+    parser.add_argument(
+        "--trace", default=None, metavar="RUN",
+        help="delegate to tools.trace_report for this trace_id or "
+        "submission run_id (the per-run waterfall + critical path)",
+    )
     args = parser.parse_args(argv)
     if args.path is None:
         if not args.staticcheck:
@@ -762,6 +791,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as exc:
         print(f"cannot read {args.path}: {exc}", file=sys.stderr)
         return 2
+    if args.trace is not None:
+        from tools.trace_report import render as render_traces
+
+        print(render_traces(records, run=args.trace))
+        return 0
+    if args.all:
+        print(render_all(records))
+        return 0
     print(render(
         records,
         run_id=args.run,

@@ -1,0 +1,181 @@
+"""``metric-docs``: the registered-metric <-> docs-catalog contract.
+
+Every counter/gauge/histogram name registered anywhere in ``deequ_tpu/``
+(a literal or f-string first argument to a ``.counter(...)`` /
+``.gauge(...)`` / ``.histogram(...)`` call, or to the repository's
+``_bump(...)`` wrapper) must have a row in the "## Metric catalog"
+section of docs/OBSERVABILITY.md — and every catalogued name must
+still be registered somewhere, so the catalog cannot rot into
+describing metrics that no longer exist.
+
+Name normalization: an f-string hole (``f"...per_shape.{label}.hits"``)
+and a docs placeholder (```engine...per_shape.<label>.hits```) both
+become ``*`` segments, so dynamic families match their one catalog row.
+Dynamic names built any other way (a plain variable argument) are
+invisible to this rule — register through a literal/f-string or
+document the family at its call site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from tools.staticcheck.core import Analyzer, Finding, SourceFile, register
+
+DOCS_REL = "docs/OBSERVABILITY.md"
+CATALOG_HEADING = "## Metric catalog"
+
+_REGISTRY_ATTRS = frozenset({"counter", "gauge", "histogram"})
+_WRAPPER_NAMES = frozenset({"_bump"})
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_PLACEHOLDER_RE = re.compile(r"<[^>]+>")
+
+
+def _literal_metric_name(node: ast.AST) -> str:
+    """The metric name of a call's first argument: a string literal
+    verbatim, an f-string with every hole collapsed to ``*``, else ''
+    (not statically resolvable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant) and isinstance(
+                piece.value, str
+            ):
+                parts.append(piece.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return ""
+
+
+def _looks_like_metric(name: str) -> bool:
+    """Filter out non-metric string arguments that happen to reach a
+    same-named method: catalogued names are dotted lowercase paths."""
+    return bool(name) and "." in name and " " not in name
+
+
+def collect_registrations(
+    files: Sequence[SourceFile],
+) -> Dict[str, List[Tuple[str, int]]]:
+    """{normalized metric name: [(rel path, line), ...]} over every
+    statically-resolvable registration site in the scanned tree."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr not in _REGISTRY_ATTRS:
+                    continue
+            elif isinstance(func, ast.Name):
+                if func.id not in _WRAPPER_NAMES:
+                    continue
+            else:
+                continue
+            name = _literal_metric_name(node.args[0])
+            if not _looks_like_metric(name):
+                continue
+            out.setdefault(name, []).append((sf.rel, node.lineno))
+    return out
+
+
+def parse_catalog(text: str) -> Dict[str, int]:
+    """{normalized metric name: line} from the backticked first cell
+    of each table row inside the "## Metric catalog" section (the
+    section ends at the next ``## `` heading)."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if stripped.startswith("## "):
+            in_section = stripped.startswith(CATALOG_HEADING)
+            continue
+        if not in_section or not stripped.startswith("|"):
+            continue
+        match = _BACKTICK_RE.search(stripped)
+        if match is None:
+            continue
+        name = _PLACEHOLDER_RE.sub("*", match.group(1)).strip()
+        if _looks_like_metric(name):
+            out.setdefault(name, lineno)
+    return out
+
+
+class MetricDocsAnalyzer(Analyzer):
+    name = "metricdocs"
+    rules = ("metric-docs",)
+    description = (
+        "every registered counter/gauge/histogram has a row in the "
+        "docs/OBSERVABILITY.md metric catalog, and vice versa"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        registered = collect_registrations(files)
+        docs_path = os.path.join(root, DOCS_REL.replace("/", os.sep))
+        if not os.path.isfile(docs_path):
+            # a tree with no metric registrations has no contract to
+            # enforce (fixture roots); one with registrations must
+            # carry the catalog
+            if registered:
+                yield Finding(
+                    rule="metric-docs",
+                    path=DOCS_REL,
+                    line=0,
+                    message=f"{DOCS_REL} is missing — the metric "
+                    "catalog lives there",
+                )
+            return
+        with open(docs_path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        documented = parse_catalog(text)
+        if not documented:
+            if registered:
+                yield Finding(
+                    rule="metric-docs",
+                    path=DOCS_REL,
+                    line=0,
+                    message=f'no "{CATALOG_HEADING}" table rows found '
+                    f"in {DOCS_REL}",
+                )
+            return
+        for name in sorted(registered):
+            if name in documented:
+                continue
+            rel, line = registered[name][0]
+            yield Finding(
+                rule="metric-docs",
+                path=rel,
+                line=line,
+                message=(
+                    f"metric '{name}' is registered here but has no "
+                    f'row in the {DOCS_REL} "{CATALOG_HEADING}" table'
+                ),
+                symbol=name,
+            )
+        for name in sorted(documented):
+            if name in registered:
+                continue
+            yield Finding(
+                rule="metric-docs",
+                path=DOCS_REL,
+                line=documented[name],
+                message=(
+                    f"catalog row for '{name}' has no registration "
+                    "site anywhere in deequ_tpu/ — stale docs"
+                ),
+                symbol=name,
+            )
+
+
+register(MetricDocsAnalyzer())
