@@ -147,6 +147,9 @@ CONFIG_REGISTRY = {
     "service_elastic_placement": (
         lambda a: bench_service_elastic_placement(a["rows"], a["clients"])
     ),
+    "service_preemption": (
+        lambda a: bench_service_preemption(a["rows"], a["clients"])
+    ),
     "spill_grouping_12M_distinct": lambda a: bench_spill_grouping(a["rows"]),
     "joint_grouping_mi_1Mcard_pair": lambda a: bench_joint_grouping(a["rows"]),
     "streaming_parquet": (
@@ -1738,6 +1741,236 @@ def bench_service_elastic_placement(
     }
 
 
+def bench_service_preemption(num_rows: int = 1_000_000, clients: int = 4):
+    """Checkpoint-conserving preemption (docs/SERVICE.md "Preemption
+    and autoscaling"): K INTERACTIVE suites arrive while long BATCH
+    runs saturate a 1-worker pool. With ``preemption=True`` the
+    running BATCH victim is cancelled at its next batch boundary
+    (final checkpoint persisted), requeued with its cursor, and
+    resumed after the interactive burst — so the measured interactive
+    p99 queue wait must match the idle-pool p99 (same K interactive
+    submissions, no BATCH load) within 10%, work must be conserved
+    (extra ``engine.data_passes`` == preemptions: one resumed
+    traversal each, which recomputes at most the one in-flight batch),
+    and every preempted-then-resumed BATCH result must be bit-equal to
+    the uninterrupted solo reference."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import pyarrow as pa
+
+    from deequ_tpu import Check, CheckLevel, config
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.service import (
+        Priority,
+        RunRequest,
+        VerificationService,
+    )
+    from deequ_tpu.telemetry import get_telemetry
+
+    def make():
+        rng = np.random.default_rng(17)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "k1": rng.integers(
+                        0, 1 << 40, num_rows, dtype=np.int64
+                    ),
+                    "v1": rng.normal(0, 1, num_rows).astype(np.float32),
+                    "v2": rng.normal(0, 1, num_rows).astype(np.float32),
+                }
+            )
+        )
+
+    def batch_suite():
+        return [
+            Check(CheckLevel.ERROR, "preempt-batch")
+            .is_complete("k1")
+            .is_non_negative("k1")
+            .is_complete("v1")
+            .is_complete("v2")
+        ]
+
+    def interactive_suite():
+        return [Check(CheckLevel.ERROR, "preempt-inter").is_complete("k1")]
+
+    def fingerprint(result):
+        return tuple(
+            sorted(
+                (str(analyzer), repr(getattr(metric, "value", metric)))
+                for analyzer, metric in dict(result.metrics).items()
+            )
+        )
+
+    def submit(svc, label, i, priority, checks):
+        return svc.submit(
+            RunRequest(
+                tenant=f"tenant-{i}",
+                checks=checks,
+                dataset_key=f"bench/preempt/{label}/{priority}/{i}",
+                dataset_factory=make,
+                priority=priority,
+            )
+        )
+
+    def wait_all(handles, timeout=600):
+        threads = [
+            threading.Thread(target=h.wait, args=(timeout,))
+            for h in handles
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+
+    def waits_of(handles):
+        return sorted(
+            max(0.0, (h.started_at or 0.0) - h.submitted_at)
+            for h in handles
+        )
+
+    tm = get_telemetry()
+    root = tempfile.mkdtemp(prefix="deequ_tpu_bench_preempt_")
+    nbatch = 2
+    # many small batches => preemption lands quickly at a boundary and
+    # the conserved-work claim (cursor skips completed batches) is
+    # about real work, not one giant batch
+    overrides = dict(
+        batch_size=max(4096, num_rows // 16), checkpoint_every_batches=1
+    )
+    try:
+        with config.configure(**overrides):
+            # solo uninterrupted BATCH reference: the bit-equality pin
+            # (also warms the plan cache for every later arm)
+            solo_svc = VerificationService(
+                workers=1, isolated=False, coalesce=False,
+                preemption=True, journal_dir=f"{root}/solo",
+            )
+            solo_svc.start()
+            try:
+                solo = submit(
+                    solo_svc, "solo", 0, Priority.BATCH, batch_suite()
+                )
+                solo.wait(600)
+                submit(
+                    solo_svc, "solo", 0, Priority.INTERACTIVE,
+                    interactive_suite(),
+                ).wait(600)  # warm the interactive plan too
+            finally:
+                solo_svc.stop(drain=False, timeout=30)
+            solo_print = fingerprint(solo.result(timeout=0))
+
+            # idle-pool reference: the SAME K interactive submissions
+            # on an identical (preemption-enabled) service with no
+            # BATCH load — the p99 the saturated arm must match
+            idle_svc = VerificationService(
+                workers=1, isolated=False, coalesce=False,
+                preemption=True, journal_dir=f"{root}/idle",
+            )
+            idle_svc.start()
+            try:
+                idle_handles = [
+                    submit(
+                        idle_svc, "idle", i, Priority.INTERACTIVE,
+                        interactive_suite(),
+                    )
+                    for i in range(clients)
+                ]
+                wait_all(idle_handles)
+            finally:
+                idle_svc.stop(drain=False, timeout=30)
+            idle_waits = waits_of(idle_handles)
+
+            # saturated arm: BATCH runs own the single worker, THEN the
+            # interactive burst arrives and must preempt through
+            preempts0 = tm.counter("service.preemptions").value
+            resumes0 = tm.counter("service.preempt_resumes").value
+            conserved0 = tm.counter(
+                "service.preempted_batches_conserved"
+            ).value
+            passes0 = tm.counter("engine.data_passes").value
+            sat_svc = VerificationService(
+                workers=1, isolated=False, coalesce=False,
+                preemption=True, journal_dir=f"{root}/sat",
+            )
+            sat_svc.start()
+            try:
+                batch_handles = [
+                    submit(
+                        sat_svc, "sat", i, Priority.BATCH, batch_suite()
+                    )
+                    for i in range(nbatch)
+                ]
+                deadline = _time.time() + 60
+                while (
+                    not any(h.started_at for h in batch_handles)
+                    and _time.time() < deadline
+                ):
+                    _time.sleep(0.01)
+                inter_handles = [
+                    submit(
+                        sat_svc, "sat", i, Priority.INTERACTIVE,
+                        interactive_suite(),
+                    )
+                    for i in range(clients)
+                ]
+                wait_all(inter_handles)
+                wait_all(batch_handles)
+            finally:
+                sat_svc.stop(drain=False, timeout=30)
+            sat_waits = waits_of(inter_handles)
+            preemptions = int(
+                tm.counter("service.preemptions").value - preempts0
+            )
+            resumes = int(
+                tm.counter("service.preempt_resumes").value - resumes0
+            )
+            conserved = int(
+                tm.counter("service.preempted_batches_conserved").value
+                - conserved0
+            )
+            data_passes = int(
+                tm.counter("engine.data_passes").value - passes0
+            )
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    idle_p99 = idle_waits[-1]
+    sat_p99 = sat_waits[-1]
+    batch_results = [h.result(timeout=0) for h in batch_handles]
+    bit_equal = all(
+        r is not None and fingerprint(r) == solo_print
+        for r in batch_results
+    )
+    # every preemption costs exactly one extra traversal entry (the
+    # resumed pass), whose cursor skips all completed batches
+    extra_passes = data_passes - (nbatch + clients)
+    return {
+        "rows": num_rows,
+        "clients": clients,
+        "idle_wait_p99_s": round(idle_p99, 4),
+        "saturated_wait_p99_s": round(sat_p99, 4),
+        # 10% relative plus a small absolute floor: at millisecond
+        # scale a single scheduler-thread wakeup would otherwise flip
+        # the verdict on noise
+        "interactive_p99_within_10pct": bool(
+            sat_p99 <= idle_p99 * 1.10 + 0.25
+        ),
+        "preemptions": preemptions,
+        "preempt_resumes": resumes,
+        "batches_conserved": conserved,
+        "data_passes": data_passes,
+        "extra_passes": extra_passes,
+        "work_conserved": bool(
+            0 <= extra_passes <= max(preemptions, 0)
+        ),
+        "preempted_results_bit_equal": bool(bit_equal),
+    }
+
+
 def bench_streaming_bundle_100m(num_rows: int = 100_000_000):
     """BASELINE.json config 2 at its SPECIFIED scale, streamed:
     Mean/StdDev/Min/Max/Compliance over 10 numeric f32 columns,
@@ -2220,6 +2453,12 @@ def main(argv=None):
                 {"rows": 1_000_000, "clients": 4},
                 False,
                 120,
+            ),
+            (
+                "service_preemption",
+                {"rows": 1_000_000, "clients": 4},
+                False,
+                150,
             ),
             ("spill_grouping_12M_distinct", {"rows": 12_000_000}, False, 120),
             (

@@ -351,6 +351,49 @@ class Options:
     service_slo_objectives: str = os.environ.get(
         "DEEQU_TPU_SERVICE_SLO_OBJECTIVES", ""
     )
+    # checkpoint-conserving preemption (service/preempt.py,
+    # docs/SERVICE.md "Preemption and autoscaling"): an INTERACTIVE
+    # ticket that finds the pool/workers saturated preempts the
+    # youngest solo BATCH run — cancel-with-checkpoint at the next
+    # batch boundary, lease revoked, ticket requeued carrying its
+    # cursor — and the victim later resumes with zero recompute and
+    # zero recompile. Opt-in: default-off allocates no controller, no
+    # per-attempt tokens, and changes no pop/finish semantics
+    service_preemption: bool = (
+        os.environ.get("DEEQU_TPU_SERVICE_PREEMPTION", "0") == "1"
+    )
+    # livelock bound: preemption requests a single run may absorb
+    # before it becomes ineligible as a victim (it then runs to
+    # completion however long interactive pressure lasts)
+    service_preempt_max_per_run: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_PREEMPT_MAX_PER_RUN", 3) or 3
+    )
+    # queue-driven autoscaling (service/autoscale.py): a control loop
+    # adjusting worker count, interactive_reserve, and the coalesce
+    # window from the per-class service.queue_wait_s.* histograms and
+    # SLO burn. Opt-in; requires an explicit decision cadence
+    service_autoscale: bool = (
+        os.environ.get("DEEQU_TPU_SERVICE_AUTOSCALE", "0") == "1"
+    )
+    service_autoscale_interval_s: float = float(
+        os.environ.get("DEEQU_TPU_SERVICE_AUTOSCALE_INTERVAL", 10.0)
+        or 10.0
+    )
+    service_autoscale_min_workers: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_AUTOSCALE_MIN_WORKERS", 1) or 1
+    )
+    service_autoscale_max_workers: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_AUTOSCALE_MAX_WORKERS", 8) or 8
+    )
+    # queue-wait the interactive class should stay under (seconds);
+    # the controller scales up / widens the reserve while the observed
+    # p99 since the last decision exceeds it
+    service_autoscale_target_interactive_p99_s: float = float(
+        os.environ.get(
+            "DEEQU_TPU_SERVICE_AUTOSCALE_TARGET_INTERACTIVE_P99", 1.0
+        )
+        or 1.0
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

@@ -46,7 +46,7 @@ import signal as _signal
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from deequ_tpu.engine.deadline import MonotonicClock
+from deequ_tpu.engine.deadline import CancelToken, MonotonicClock
 from deequ_tpu.engine.resilience import TransientScanError
 
 #: env var the parent sets so the spawned child pins the same jax
@@ -292,6 +292,26 @@ def _apply_child_platform() -> None:
         pass
 
 
+#: the cancel token for THIS process when it runs as an isolated child
+#: — ``_child_main`` mints it fresh per child and a watcher thread
+#: fires it when the parent sends a cancel message down the control
+#: pipe. The child's work (``service._isolated_execute``) threads it
+#: into the engine as ``cancel=``, so a preemption reaches a spawned
+#: scan exactly like an in-process one: clean exit at the next batch
+#: boundary, final checkpoint persisted, partial result shipped back
+#: over the result pipe — never a SIGKILL.
+_child_cancel: Optional[CancelToken] = None
+
+
+def child_cancel_token() -> CancelToken:
+    """The process-global cancel token a spawned child's work observes
+    (a fresh, never-fired token outside a child)."""
+    global _child_cancel
+    if _child_cancel is None:
+        _child_cancel = CancelToken()
+    return _child_cancel
+
+
 def _child_trace(tm: Any) -> Optional[Any]:
     """Decode the parent's shipped trace (``CHILD_TRACE_ENV``) into the
     child's ambient context, re-tagged with a ``/child`` process label
@@ -305,7 +325,26 @@ def _child_trace(tm: Any) -> Optional[Any]:
     return TraceContext(ctx.trace_id, ctx.span_id, process=label)
 
 
-def _child_main(conn: Any, fn: Callable[[Any], Any], payload: Any) -> None:
+def _watch_parent_cancel(cancel_conn: Any, token: CancelToken) -> None:
+    """Child-side watcher: one blocking recv on the control pipe; a
+    ``("cancel", reason)`` message fires the child's token. EOF (parent
+    closed the pipe, i.e. the run ended without a cancel) just ends the
+    watcher."""
+    try:
+        msg = cancel_conn.recv()
+    except Exception:  # noqa: BLE001 — EOF/torn pipe: no cancel came
+        return
+    if isinstance(msg, tuple) and msg and msg[0] == "cancel":
+        reason = msg[1] if len(msg) > 1 else "cancelled by parent"
+        token.cancel(str(reason))
+
+
+def _child_main(
+    conn: Any,
+    cancel_conn: Any,
+    fn: Callable[[Any], Any],
+    payload: Any,
+) -> None:
     """Spawn entry point: run ``fn(payload)`` and ship ``("ok", result,
     telemetry_summary)`` or ``("err", exception, telemetry_summary)``
     back over the pipe. Anything that cannot pickle degrades to a
@@ -319,6 +358,17 @@ def _child_main(conn: Any, fn: Callable[[Any], Any], payload: Any) -> None:
     from deequ_tpu.telemetry import get_telemetry
 
     tm = get_telemetry()
+    global _child_cancel
+    _child_cancel = CancelToken()
+    # lint-ok: thread-discipline: child-process watcher, daemon by
+    # design — it blocks on the control pipe for the child's whole
+    # life and dies with the process; it never touches a scan
+    threading.Thread(
+        target=_watch_parent_cancel,
+        args=(cancel_conn, _child_cancel),
+        daemon=True,
+        name="deequ-tpu-child-cancel",
+    ).start()
     ctx = _child_trace(tm)
     send_lock = threading.Lock()
     if ctx is not None:
@@ -422,6 +472,7 @@ class IsolatedRunner:
         breaker: Optional[CircuitBreaker] = None,
         use_breaker: bool = True,
         clock: Optional[Any] = None,
+        cancel_token: Optional[CancelToken] = None,
     ):
         from deequ_tpu import config
 
@@ -437,6 +488,14 @@ class IsolatedRunner:
         if breaker is None and use_breaker and key:
             breaker = breaker_for(key, clock=clock)
         self.breaker = breaker
+        # cooperative cancel across the process boundary: when this
+        # token fires (client cancel OR a preemption), the parent sends
+        # one ("cancel", reason) message down the child's control pipe
+        # and keeps WAITING — the child exits cleanly through its
+        # checkpoint path and ships its partial result; the runner
+        # never escalates a cancel to terminate()/kill() (that is the
+        # deadline path's job)
+        self.cancel_token = cancel_token
         self._ctx = multiprocessing.get_context("spawn")
 
     # -- single launch ---------------------------------------------------
@@ -448,9 +507,12 @@ class IsolatedRunner:
 
         tm = get_telemetry()
         parent_conn, child_conn = self._ctx.Pipe(duplex=False)
+        # control pipe, parent -> child: carries at most one
+        # ("cancel", reason) message (see _watch_parent_cancel)
+        cancel_recv, cancel_send = self._ctx.Pipe(duplex=False)
         proc = self._ctx.Process(
             target=_child_main,
-            args=(child_conn, fn, payload),
+            args=(child_conn, cancel_recv, fn, payload),
             daemon=False,
         )
         platform = _parent_platform()
@@ -483,9 +545,11 @@ class IsolatedRunner:
             else:
                 os.environ[CHILD_TRACE_ENV] = prev_trace_env
         child_conn.close()  # parent's copy; the child holds the real end
+        cancel_recv.close()  # ditto for the control pipe's read end
         message = None
         poll_expired = False
         timed_out = False
+        cancel_sent = False
         spans: list = []
         clk = MonotonicClock()
         deadline = (
@@ -498,13 +562,44 @@ class IsolatedRunner:
                 # the deadline. Spans collected here survive a crash —
                 # they are replayed below even when no final message
                 # ever arrives, so the trace shows where the child died.
+                # With a cancel token the wait is sliced so a cancel
+                # firing mid-run reaches the child promptly.
                 while True:
+                    if (
+                        self.cancel_token is not None
+                        and not cancel_sent
+                        and self.cancel_token.cancelled
+                    ):
+                        cancel_sent = True
+                        try:
+                            cancel_send.send(
+                                (
+                                    "cancel",
+                                    self.cancel_token.reason
+                                    or "cancelled",
+                                )
+                            )
+                        except Exception:  # noqa: BLE001 — child gone:
+                            pass  # the result loop classifies that
                     remaining = (
                         None
                         if deadline is None
                         else max(0.0, deadline - clk.now())
                     )
-                    if not parent_conn.poll(remaining):
+                    if remaining is not None and remaining <= 0.0:
+                        poll_expired = True
+                        break
+                    if self.cancel_token is not None:
+                        wait = (
+                            0.05
+                            if remaining is None
+                            else min(0.05, remaining)
+                        )
+                    else:
+                        wait = remaining
+                    if not parent_conn.poll(wait):
+                        if self.cancel_token is not None:
+                            continue  # slice over; re-check the token
                         poll_expired = True
                         break
                     msg = parent_conn.recv()
@@ -533,6 +628,10 @@ class IsolatedRunner:
                 timed_out = True
                 proc.terminate()
         finally:
+            try:
+                cancel_send.close()
+            except Exception:  # noqa: BLE001 — already torn
+                pass
             proc.join(self.timeout_s)
             if proc.is_alive():  # terminate() ignored — escalate
                 proc.kill()

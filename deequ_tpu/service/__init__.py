@@ -28,8 +28,14 @@ runner's admission layer, never ``engine.run_scan`` directly (also
 lint-enforced).
 """
 
+from deequ_tpu.service.autoscale import AutoscaleController
 from deequ_tpu.service.caches import DatasetCache, PlanCache
 from deequ_tpu.service.journal import RunJournal
+from deequ_tpu.service.preempt import (
+    PreemptionController,
+    preempt_checkpoint_evidence,
+    run_cancel_token,
+)
 from deequ_tpu.service.placement import (
     DevicePool,
     ElasticPlacer,
@@ -53,6 +59,7 @@ from deequ_tpu.service.service import (
 )
 
 __all__ = [
+    "AutoscaleController",
     "DatasetCache",
     "DevicePool",
     "ElasticPlacer",
@@ -60,6 +67,7 @@ __all__ = [
     "PlacementLease",
     "PlacementPolicy",
     "PlanCache",
+    "PreemptionController",
     "Priority",
     "QuotaExceeded",
     "RunHandle",
@@ -71,4 +79,6 @@ __all__ = [
     "Scheduler",
     "ServiceOverloaded",
     "VerificationService",
+    "preempt_checkpoint_evidence",
+    "run_cancel_token",
 ]

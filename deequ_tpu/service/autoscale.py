@@ -1,0 +1,336 @@
+"""Queue-driven autoscaling: the control loop over the wait histograms.
+
+The scheduler exposes its knobs (``resize`` for worker count and
+interactive reserve; the ``coalesce`` policy attribute for the BATCH
+hold-back window) and the telemetry registry already accumulates
+per-class queue-wait histograms (``service.queue_wait_s.interactive``,
+``.batch``, …). The :class:`AutoscaleController` closes the loop:
+every ``interval_s`` it diffs the histogram snapshots against its last
+reading (cumulative-bucket deltas -> an approximate interval p99),
+reads queue depth and — when the service tracks SLOs — error-budget
+burn, and actuates:
+
+- **interactive pressure** (interval p99 over target, or an
+  interactive SLO burning) -> one more worker (capped), at least one
+  reserved for the INTERACTIVE class;
+- **batch starvation** (batch interval p99 dwarfing the coalesce
+  window's possible benefit) -> halve the window, so held-back tickets
+  stop paying for peers that never arrive;
+- **sustained idleness** (no waits observed, empty queue, several
+  consecutive intervals — hysteresis against flapping) -> one worker
+  down (floored), window restored toward its configured base.
+
+Every actuation increments ``service.autoscale_adjustments`` and emits
+an ``autoscale_adjustment`` event naming the knob, both values, and
+the reason — the decision trail is replayable from the event log
+alone. ``step()`` is synchronous and side-effect-complete so fake-time
+tests drive the controller without the thread; the thread is just
+``step()`` under an ``Event.wait`` cadence (never ``time.sleep`` —
+service-time discipline), and decisions are timed on the injected
+clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Any, Dict, List, Optional
+
+from deequ_tpu.engine.deadline import MonotonicClock
+from deequ_tpu.telemetry import get_telemetry
+
+INTERACTIVE_WAIT = "service.queue_wait_s.interactive"
+BATCH_WAIT = "service.queue_wait_s.batch"
+
+#: consecutive quiet intervals before a scale-down — the hysteresis
+#: that keeps a bursty workload from sawtoothing the pool
+IDLE_ROUNDS_BEFORE_SCALE_DOWN = 3
+
+
+def interval_p99(
+    prev: Optional[Dict[str, Any]], cur: Optional[Dict[str, Any]]
+) -> Optional[float]:
+    """Approximate p99 of the observations that landed BETWEEN two
+    cumulative histogram snapshots: subtract the cumulative bucket
+    counts and walk to the first bound covering 99% of the interval's
+    observations. None when the interval saw no observations. Beyond
+    the top bucket the all-time max is the best available bound."""
+    count = (cur["count"] if cur else 0) - (prev["count"] if prev else 0)
+    if count <= 0:
+        return None
+    target = math.ceil(0.99 * count)
+    prev_buckets = prev["buckets"] if prev else {}
+    for bound, cum in cur["buckets"].items():
+        if cum - prev_buckets.get(bound, 0) >= target:
+            return float(bound)
+    top = cur.get("max")
+    return float(top) if top is not None else math.inf
+
+
+class AutoscaleController:
+    """The feedback loop between the queue-wait histograms and the
+    scheduler's capacity knobs. One instance per service; inert until
+    ``start()`` (or a test calling ``step()`` directly)."""
+
+    def __init__(
+        self,
+        scheduler: Any,
+        clock: Any = None,
+        interval_s: float = 10.0,
+        min_workers: int = 1,
+        max_workers: int = 8,
+        target_interactive_p99_s: float = 1.0,
+        slo: Optional[Any] = None,
+    ):
+        self.scheduler = scheduler
+        self.clock = clock or MonotonicClock()
+        self.interval_s = max(0.01, float(interval_s))
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.target_interactive_p99_s = float(target_interactive_p99_s)
+        self.slo = slo
+        # the window the operator configured is the ceiling any
+        # restore converges back to
+        policy = getattr(scheduler, "coalesce", None)
+        self._base_window_s = (
+            float(policy.window_s) if policy is not None else 0.0
+        )
+        self._prev: Dict[str, Optional[Dict[str, Any]]] = {}
+        # the first step only baselines the cumulative snapshots: the
+        # registry may hold hours of pre-controller history, and
+        # actuating on an all-time p99 would mis-size the pool at
+        # startup for waits nobody is currently experiencing
+        self._primed = False
+        self._idle_rounds = 0
+        self._steps = 0
+        self._adjustments = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        # lint-ok: thread-discipline: service-scoped control loop
+        # joined in stop(); not part of a scan, so the ingest probe
+        # (which tier-1 asserts empty between scans) must not see it
+        self._thread = threading.Thread(
+            target=self._loop,
+            daemon=True,
+            name="deequ-tpu-service-autoscale",
+        )
+        self._thread.start()
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        # Event.wait paces the loop (wakes immediately on stop());
+        # REAL cadence even under a fake service clock — the decisions
+        # themselves are timed on the injected clock
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — a control-loop bug must
+                pass  # never take down the service it steers
+
+    # -- one control decision -----------------------------------------
+
+    def step(self) -> List[Dict[str, Any]]:
+        """Read the signals, actuate at most a one-notch change per
+        knob, return the adjustments made (empty = steady state)."""
+        tm = get_telemetry()
+        hists = tm.metrics.snapshot()["histograms"]
+        inter_cur = hists.get(INTERACTIVE_WAIT)
+        batch_cur = hists.get(BATCH_WAIT)
+        inter_p99 = interval_p99(
+            self._prev.get(INTERACTIVE_WAIT), inter_cur
+        )
+        batch_p99 = interval_p99(self._prev.get(BATCH_WAIT), batch_cur)
+        self._prev[INTERACTIVE_WAIT] = inter_cur
+        self._prev[BATCH_WAIT] = batch_cur
+        depth = self.scheduler.queue.depth()
+        self._steps += 1
+        if not self._primed:
+            self._primed = True
+            return []
+
+        adjustments: List[Dict[str, Any]] = []
+        pressure_reason = self._interactive_pressure(inter_p99)
+        if pressure_reason is not None:
+            self._idle_rounds = 0
+            self._scale_up(adjustments, pressure_reason)
+        elif inter_p99 is None and batch_p99 is None and depth == 0:
+            self._idle_rounds += 1
+            if self._idle_rounds >= IDLE_ROUNDS_BEFORE_SCALE_DOWN:
+                self._idle_rounds = 0
+                self._scale_down(adjustments)
+        else:
+            self._idle_rounds = 0
+        self._adjust_window(adjustments, batch_p99)
+
+        for adj in adjustments:
+            self._adjustments += 1
+            tm.counter("service.autoscale_adjustments").inc()
+            tm.event("autoscale_adjustment", at=self.clock.now(), **adj)
+        return adjustments
+
+    def _interactive_pressure(
+        self, inter_p99: Optional[float]
+    ) -> Optional[str]:
+        """Why the INTERACTIVE class needs more capacity, or None."""
+        if (
+            inter_p99 is not None
+            and inter_p99 > self.target_interactive_p99_s
+        ):
+            return (
+                f"interactive interval p99 ~{inter_p99:g}s over "
+                f"target {self.target_interactive_p99_s:g}s"
+            )
+        if self.slo is not None:
+            try:
+                classes = self.slo.snapshot().get("classes", {})
+            except Exception:  # noqa: BLE001 — advisory signal only
+                return None
+            burn = (classes.get("interactive") or {}).get("budget_burn")
+            if burn is not None and burn > 1.0:
+                return f"interactive SLO budget burning at {burn:g}x"
+        return None
+
+    # -- actuators ----------------------------------------------------
+
+    def _scale_up(
+        self, adjustments: List[Dict[str, Any]], reason: str
+    ) -> None:
+        workers = self.scheduler.workers
+        reserve = self.scheduler.interactive_reserve
+        new_workers = min(self.max_workers, workers + 1)
+        # under pressure at least one worker must be fenced off for
+        # the INTERACTIVE class, or added capacity just grows the
+        # batch residency the class is waiting behind
+        new_reserve = max(reserve, 1 if new_workers > 1 else 0)
+        if new_workers == workers and new_reserve == reserve:
+            return
+        self.scheduler.resize(
+            workers=new_workers, interactive_reserve=new_reserve
+        )
+        if new_workers != workers:
+            adjustments.append(
+                {
+                    "knob": "workers",
+                    "from_value": workers,
+                    "to_value": self.scheduler.workers,
+                    "reason": reason,
+                }
+            )
+        if self.scheduler.interactive_reserve != reserve:
+            adjustments.append(
+                {
+                    "knob": "interactive_reserve",
+                    "from_value": reserve,
+                    "to_value": self.scheduler.interactive_reserve,
+                    "reason": reason,
+                }
+            )
+
+    def _scale_down(self, adjustments: List[Dict[str, Any]]) -> None:
+        workers = self.scheduler.workers
+        if workers <= self.min_workers:
+            return
+        reserve = self.scheduler.interactive_reserve
+        self.scheduler.resize(workers=workers - 1)
+        adjustments.append(
+            {
+                "knob": "workers",
+                "from_value": workers,
+                "to_value": self.scheduler.workers,
+                "reason": (
+                    f"{IDLE_ROUNDS_BEFORE_SCALE_DOWN} consecutive idle "
+                    f"intervals"
+                ),
+            }
+        )
+        if self.scheduler.interactive_reserve != reserve:
+            # resize clamps the reserve under the shrunk pool
+            adjustments.append(
+                {
+                    "knob": "interactive_reserve",
+                    "from_value": reserve,
+                    "to_value": self.scheduler.interactive_reserve,
+                    "reason": "clamped under scale-down",
+                }
+            )
+
+    def _adjust_window(
+        self,
+        adjustments: List[Dict[str, Any]],
+        batch_p99: Optional[float],
+    ) -> None:
+        """Shrink the coalesce hold-back window while BATCH interval
+        p99 dwarfs what waiting for peers could save; restore toward
+        the configured base once batch waits subside."""
+        policy = getattr(self.scheduler, "coalesce", None)
+        if policy is None or self._base_window_s <= 0:
+            return
+        window = float(policy.window_s)
+        new_window = window
+        if (
+            batch_p99 is not None
+            and window > 0
+            and batch_p99 > 4.0 * self._base_window_s
+        ):
+            new_window = window / 2.0
+            if new_window < 0.01:
+                new_window = 0.0
+            reason = (
+                f"batch interval p99 ~{batch_p99:g}s dwarfs the "
+                f"{self._base_window_s:g}s hold-back window"
+            )
+        elif (
+            window < self._base_window_s
+            and (
+                batch_p99 is None or batch_p99 <= self._base_window_s
+            )
+        ):
+            new_window = min(
+                self._base_window_s, max(0.01, window * 2.0)
+            )
+            reason = "batch waits subsided; restoring toward base"
+        if new_window == window:
+            return
+        self.scheduler.coalesce = dataclasses.replace(
+            policy, window_s=new_window
+        )
+        get_telemetry().metrics.gauge(
+            "service.coalesce_window_s"
+        ).set(new_window)
+        adjustments.append(
+            {
+                "knob": "coalesce_window_s",
+                "from_value": window,
+                "to_value": new_window,
+                "reason": reason,
+            }
+        )
+
+    # -- introspection ------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        policy = getattr(self.scheduler, "coalesce", None)
+        return {
+            "steps": self._steps,
+            "adjustments": self._adjustments,
+            "workers": self.scheduler.workers,
+            "interactive_reserve": self.scheduler.interactive_reserve,
+            "coalesce_window_s": (
+                float(policy.window_s) if policy is not None else None
+            ),
+            "target_interactive_p99_s": self.target_interactive_p99_s,
+            "idle_rounds": self._idle_rounds,
+        }

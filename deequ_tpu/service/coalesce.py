@@ -57,8 +57,15 @@ class CoalescePolicy:
         run must never inherit a batch suite's runtime. Row-level-sink
         runs never coalesce either — the egress artifact is per-run
         (one writer, one manifest), while a superset scan serves many
-        tenants from one traversal."""
+        tenants from one traversal. A PREEMPTED run resumes solo: its
+        durable cursor is keyed to the plan token of the scan it was
+        interrupted in, and joining a superset group would change that
+        token — the cursor would not load and every conserved batch
+        would be recomputed (docs/SERVICE.md "Preemption and
+        autoscaling")."""
         if getattr(ticket.payload, "row_level_sink", None) is not None:
+            return False
+        if getattr(ticket, "preemptions", 0) > 0:
             return False
         return ticket.handle.priority > Priority.INTERACTIVE
 

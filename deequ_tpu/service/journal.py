@@ -36,8 +36,19 @@ from typing import Any, Dict, Iterator, List, Optional
 KEY_PREFIX = "runlog-"
 KEY_SUFFIX = ".rec"
 
-#: lifecycle transitions a record may carry
-RECORD_TYPES = ("submitted", "started", "checkpoint", "terminal")
+#: lifecycle transitions a record may carry. ``preempted`` / ``resumed``
+#: bracket a checkpoint-conserving preemption (docs/SERVICE.md
+#: "Preemption and autoscaling"): neither is terminal, so a service
+#: killed between the two still sees the run in ``pending_runs()`` and
+#: ``recover()`` resumes it from its cursor.
+RECORD_TYPES = (
+    "submitted",
+    "started",
+    "checkpoint",
+    "preempted",
+    "resumed",
+    "terminal",
+)
 
 
 def _encode(body: Dict[str, Any]) -> bytes:
@@ -120,6 +131,15 @@ class RunJournal:
     def record_checkpoint(self, run_id: str, **fields: Any) -> int:
         return self.append("checkpoint", run_id, **fields)
 
+    def record_preempted(self, run_id: str, **fields: Any) -> int:
+        """Written AFTER the victim's final checkpoint persisted and
+        BEFORE its ticket re-enters the queue (write-ahead, same
+        discipline as ``submitted``)."""
+        return self.append("preempted", run_id, **fields)
+
+    def record_resumed(self, run_id: str, **fields: Any) -> int:
+        return self.append("resumed", run_id, **fields)
+
     def record_terminal(self, run_id: str, state: str, **fields: Any) -> int:
         return self.append("terminal", run_id, state=state, **fields)
 
@@ -157,7 +177,10 @@ class RunJournal:
         """run_id -> state for every journaled run WITHOUT a terminal
         record, in submit order: the submitted record's fields plus
         ``started`` (bool) and ``last_checkpoint`` (fields of the latest
-        checkpoint record, or None)."""
+        checkpoint record, or None), plus the preemption bracket:
+        ``preempted`` (True while a preemption record is not yet
+        matched by a ``resumed`` one), ``preempt_count``, and
+        ``last_preemption`` (the latest preemption record's fields)."""
         pending: Dict[str, Dict[str, Any]] = {}
         for record in self.replay():
             run_id = record.get("run_id")
@@ -172,6 +195,9 @@ class RunJournal:
                 }
                 entry["started"] = False
                 entry["last_checkpoint"] = None
+                entry["preempted"] = False
+                entry["preempt_count"] = 0
+                entry["last_preemption"] = None
                 pending[run_id] = entry
             elif run_id in pending:
                 if rtype == "started":
@@ -182,6 +208,17 @@ class RunJournal:
                         for k, v in record.items()
                         if k not in ("type", "seq", "run_id")
                     }
+                elif rtype == "preempted":
+                    entry = pending[run_id]
+                    entry["preempted"] = True
+                    entry["preempt_count"] += 1
+                    entry["last_preemption"] = {
+                        k: v
+                        for k, v in record.items()
+                        if k not in ("type", "seq", "run_id")
+                    }
+                elif rtype == "resumed":
+                    pending[run_id]["preempted"] = False
                 elif rtype == "terminal":
                     del pending[run_id]
         return pending

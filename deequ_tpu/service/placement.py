@@ -395,6 +395,34 @@ class ElasticPlacer:
             active
         )
 
+    def revoke(
+        self,
+        lease: PlacementLease,
+        run_ids: Any = (),
+        reason: str = "preempted",
+    ) -> None:
+        """Reclaim a PREEMPTED group's slice (docs/SERVICE.md
+        "Preemption and autoscaling"): the same idempotent return to
+        the pool as ``release``, accounted separately so the
+        observability plane can tell a slice freed by completion from
+        one taken back under interactive pressure. The scheduler only
+        reaches this after extracting checkpoint evidence for the
+        victim (``preempt_checkpoint_evidence``; the staticcheck
+        ``preempt-discipline`` rule pins that ordering), so a revoked
+        lease never strands un-checkpointed work."""
+        if lease.released:
+            return
+        tm = get_telemetry()
+        tm.counter("service.lease_revocations").inc()
+        tm.event(
+            "service_lease_revoked",
+            ndev=lease.ndev,
+            device_ids=list(getattr(lease, "device_ids", ()) or ()),
+            run_ids=list(run_ids),
+            reason=reason,
+        )
+        self.release(lease)
+
     # -- introspection ---------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:

@@ -1,0 +1,232 @@
+"""Checkpoint-conserving preemption: interactive latency over BATCH.
+
+When an INTERACTIVE ticket finds every worker busy or the
+``DevicePool`` exhausted, the :class:`PreemptionController` picks the
+YOUNGEST running solo BATCH group and fires its per-attempt preempt
+token. The engine already knows how to die well: the cancel tunnels in
+as ``ScanInterrupted``, the scan exits cleanly at the next batch
+boundary, and — with a checkpointer attached — persists a final
+``ScanCursor`` (``interruption.checkpointed=True``). The worker that
+owns the victim then takes the preemption path instead of the terminal
+one: journal a ``preempted`` record, revoke the placement lease, and
+requeue the ticket at its ORIGINAL sequence number, so the victim
+resumes ahead of any batch work submitted after it — on whatever slice
+frees up, with zero recompute (cursor resume is keyed to the source
+fingerprint + plan token, not the slice) and zero recompile (the
+shape-keyed plan cache replays the compiled plan on any same-shape
+slice).
+
+The conservation invariant (docs/SERVICE.md "Preemption and
+autoscaling"): no preemption may lose or duplicate a batch. Every
+requeue/revoke call site is therefore required — structurally, by the
+``preempt-discipline`` staticcheck rule — to first extract the
+checkpoint-bearing cancel evidence via
+:func:`preempt_checkpoint_evidence`; a ticket with no such evidence
+(it completed before the cancel landed, or the USER's own token fired)
+takes the normal terminal path and is never requeued.
+
+Token layering: the preempt token is a CHILD of the handle's cancel
+token (``CancelToken(parent=...)``), so a client cancel still
+propagates into a running victim, while a preemption never marks the
+handle cancelled — the run is QUEUED again, not terminal.
+
+Everything here is allocated only when ``config.service_preemption``
+is on; off (the default) the scheduler holds no controller, tickets
+carry no preempt token, and ``run_cancel_token`` degrades to the
+handle token the executor always used.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from deequ_tpu.engine.deadline import (
+    CancelToken,
+    RunCancelled,
+    ScanInterruption,
+)
+from deequ_tpu.service.queue import Priority, RunTicket
+from deequ_tpu.telemetry import get_telemetry
+
+#: every preemption cancel reason starts with this — it is how the
+#: evidence extractor tells a preemption apart from a client cancel
+PREEMPT_REASON_PREFIX = "preempted:"
+
+_UNSET = object()
+
+
+def preempt_reason(run_id: str, demand: str) -> str:
+    return f"{PREEMPT_REASON_PREFIX} run {run_id} yielded to {demand}"
+
+
+def is_preempt_reason(reason: Any) -> bool:
+    return isinstance(reason, str) and reason.startswith(
+        PREEMPT_REASON_PREFIX
+    )
+
+
+def run_cancel_token(ticket: RunTicket) -> CancelToken:
+    """The token the executor hands the engine for this attempt: the
+    per-attempt preempt token when preemption armed one, else the
+    handle's own token (bit-for-bit today's behavior)."""
+    token = getattr(ticket, "preempt_token", None)
+    return token if token is not None else ticket.handle.cancel_token
+
+
+def preempt_checkpoint_evidence(
+    ticket: RunTicket, outcome: Any = _UNSET
+) -> Optional[ScanInterruption]:
+    """The checkpoint-bearing cancel evidence licensing a requeue.
+
+    Called WITH an outcome (a result or the exception the execution
+    raised) it computes the evidence and caches it on the ticket;
+    called without, it returns the cached evidence — so a later call
+    site (lease revocation) reads the same verdict the finish path
+    established. Returns ``None`` — take the normal terminal path —
+    unless ALL of:
+
+    - a preemption was actually requested for this attempt,
+    - the client's own cancel token did NOT fire (a user cancel always
+      wins: the run terminates CANCELLED with its partial result, it
+      is not silently requeued), and
+    - the outcome carries a cancel interruption whose reason is a
+      preemption reason (or IS the ``RunCancelled`` the preempt token
+      raised before the scan started — then the evidence is a
+      synthetic un-checkpointed interruption: nothing ran, nothing to
+      conserve, the requeue restarts from the last durable cursor).
+    """
+    if outcome is _UNSET:
+        return getattr(ticket, "preempt_evidence", None)
+    evidence: Optional[ScanInterruption] = None
+    if getattr(ticket, "preempt_requested", False) and not (
+        ticket.handle.cancel_token.cancelled
+    ):
+        if isinstance(outcome, BaseException):
+            if isinstance(outcome, RunCancelled) and is_preempt_reason(
+                str(outcome)
+            ):
+                evidence = ScanInterruption(
+                    kind="cancelled",
+                    reason=str(outcome),
+                    checkpointed=False,
+                )
+        else:
+            interruption = getattr(outcome, "interruption", None)
+            if (
+                interruption is not None
+                and getattr(interruption, "kind", "") == "cancelled"
+                and is_preempt_reason(getattr(interruption, "reason", ""))
+            ):
+                evidence = interruption
+    ticket.preempt_evidence = evidence
+    return evidence
+
+
+class _RunningGroup:
+    """One executing group as the controller sees it."""
+
+    __slots__ = ("tickets", "started_at", "eligible", "requested")
+
+    def __init__(
+        self, tickets: List[RunTicket], started_at: float, eligible: bool
+    ):
+        self.tickets = tickets
+        self.started_at = started_at
+        self.eligible = eligible
+        self.requested = False
+
+
+class PreemptionController:
+    """Registry of running groups + the victim-selection policy.
+
+    Victims are SOLO all-BATCH groups only: a coalesced superset scan
+    checkpoints under the GROUP's merged plan token, which a member
+    resuming solo could not load — preempting one would recompute every
+    member's work and break conservation. Queued or window-held BATCH
+    tickets are never victims either: they hold no capacity, and the
+    queue already yields them by skip (preemption-aware ``pop_group``),
+    which costs nothing.
+    """
+
+    def __init__(self, clock: Any, max_preemptions_per_run: int = 3):
+        self.clock = clock
+        self.max_preemptions_per_run = max(1, int(max_preemptions_per_run))
+        self._lock = threading.Lock()
+        self._running: List[_RunningGroup] = []
+
+    # -- scheduler-side bookkeeping ----------------------------------
+
+    def register(self, group: List[RunTicket]) -> _RunningGroup:
+        """Arm a group about to execute: every member gets a fresh
+        per-attempt preempt token (child of its handle token) and a
+        clean evidence slate. Returns the record to ``deregister``."""
+        for ticket in group:
+            ticket.preempt_token = CancelToken(
+                parent=ticket.handle.cancel_token
+            )
+            ticket.preempt_requested = False
+            ticket.preempt_evidence = None
+        eligible = len(group) == 1 and all(
+            t.handle.priority >= Priority.BATCH
+            and t.preemptions < self.max_preemptions_per_run
+            for t in group
+        )
+        record = _RunningGroup(group, self.clock.now(), eligible)
+        with self._lock:
+            self._running.append(record)
+        return record
+
+    def deregister(self, record: _RunningGroup) -> None:
+        with self._lock:
+            try:
+                self._running.remove(record)
+            except ValueError:
+                pass
+
+    # -- the preemption decision -------------------------------------
+
+    def preempt_for(self, demand: str) -> bool:
+        """Preempt the youngest eligible running BATCH group on behalf
+        of ``demand`` (an interactive run id). Returns True when a
+        victim was cancelled; False when nothing is preemptible (the
+        demand then waits its turn like today)."""
+        with self._lock:
+            candidates = [
+                r
+                for r in self._running
+                if r.eligible and not r.requested
+            ]
+            if not candidates:
+                return False
+            victim = max(
+                candidates,
+                key=lambda r: (r.started_at, r.tickets[0].seq),
+            )
+            victim.requested = True
+        tm = get_telemetry()
+        for ticket in victim.tickets:
+            ticket.preempt_requested = True
+            ticket.preemptions += 1
+            reason = preempt_reason(ticket.handle.run_id, demand)
+            ticket.preempt_token.cancel(reason)
+            tm.counter("service.preemptions").inc()
+            tm.event(
+                "service_run_preempt_requested",
+                run_id=ticket.handle.run_id,
+                tenant=ticket.handle.tenant,
+                demand=demand,
+                preemptions=ticket.preemptions,
+            )
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "running_groups": len(self._running),
+                "eligible_victims": sum(
+                    1
+                    for r in self._running
+                    if r.eligible and not r.requested
+                ),
+            }

@@ -195,6 +195,14 @@ class RunHandle:
             if self._state == RunState.QUEUED:
                 self._state = RunState.RUNNING
 
+    def _requeue(self) -> None:
+        """Preemption transition: RUNNING back to QUEUED. A no-op once
+        terminal (a client cancel that raced the preemption wins — the
+        scheduler never requeues a handle whose own token fired)."""
+        with self._lock:
+            if self._state == RunState.RUNNING:
+                self._state = RunState.QUEUED
+
     def _finish(
         self,
         state: str,
@@ -255,6 +263,16 @@ class RunTicket:
     # back as a host (waiting for peers) — the scheduler turns the
     # difference from submitted_at into the coalesce_window span
     coalesce_held_until: float = 0.0
+    # checkpoint-conserving preemption (service/preempt.py): the
+    # per-attempt cancel token (child of the handle token) armed just
+    # before execution, whether this attempt was asked to yield, the
+    # checkpoint-bearing interruption that licensed the requeue, and
+    # how many preemptions this run has absorbed so far (the livelock
+    # bound). All None/0 when service_preemption is off.
+    preempt_token: Optional[CancelToken] = None
+    preempt_requested: bool = False
+    preempt_evidence: Optional[Any] = None
+    preemptions: int = 0
 
     @property
     def sort_key(self):
@@ -322,6 +340,37 @@ class RunQueue:
             self._pending_by_tenant[tenant] = pending + 1
             self._cond.notify_all()
         tm.metrics.gauge("service.queue_depth").set(self.depth())
+
+    def requeue(self, ticket: RunTicket) -> bool:
+        """Return a PREEMPTED ticket to the queue (the scheduler's
+        cancel→checkpoint→requeue path; docs/SERVICE.md "Preemption and
+        autoscaling"). The original ``seq`` is preserved, so within the
+        BATCH class the victim resumes ahead of anything submitted
+        after it — preemption changes WHEN it runs, never its place in
+        line. ``submitted_at`` is re-stamped so the resume leg measures
+        its own queue wait (the autoscaler reads those histograms); the
+        budget is NOT restarted (``RunBudget.start`` pinned the
+        deadline at the original submit — preemption does not extend a
+        deadline). Returns False when the queue already closed: there
+        is nothing to resume into, and the caller applies normal
+        terminal semantics instead."""
+        tenant = ticket.handle.tenant
+        with self._cond:
+            if self._closed:
+                return False
+            ticket.lease = None
+            ticket.coalesce_held_until = 0.0
+            ticket.submitted_at = self.clock.now()
+            ticket.handle._requeue()
+            self._queued.append(ticket)
+            self._pending_by_tenant[tenant] = (
+                self._pending_by_tenant.get(tenant, 0) + 1
+            )
+            self._cond.notify_all()
+        get_telemetry().metrics.gauge("service.queue_depth").set(
+            self.depth()
+        )
+        return True
 
     # -- consumer side --------------------------------------------------
 
@@ -394,7 +443,10 @@ class RunQueue:
         return active + taking.get(tenant, 0) >= self.tenant_max_active
 
     def _take_group_locked(
-        self, max_priority: Optional[int], policy: Optional[Any]
+        self,
+        max_priority: Optional[int],
+        policy: Optional[Any],
+        defer_batch: Optional[Callable[[], bool]] = None,
     ) -> Optional[List[RunTicket]]:
         """Best live ticket this worker may take PLUS every compatible
         queued ticket the coalesce policy lets it absorb — one critical
@@ -410,6 +462,13 @@ class RunQueue:
         coalescing = policy is not None and getattr(
             policy, "enabled", False
         )
+        # preemption-aware pop: while an INTERACTIVE group is waiting
+        # for capacity, queued/window-held BATCH tickets yield by SKIP
+        # — they stay queued at their seq, untouched, rather than
+        # racing the interactive into the pool only to be
+        # cancel-preempted moments later (docs/SERVICE.md "Preemption
+        # and autoscaling"). Evaluated once per scan.
+        deferring = defer_batch is not None and defer_batch()
         now = self.clock.now() if coalescing else 0.0
         live: List[RunTicket] = []
         dead: List[RunTicket] = []
@@ -428,6 +487,8 @@ class RunQueue:
             ):
                 continue
             if self._at_active_quota_locked(ticket.handle.tenant, taking):
+                continue
+            if deferring and ticket.handle.priority >= Priority.BATCH:
                 continue
             if coalescing and policy.may_coalesce(ticket):
                 peers = sum(
@@ -456,6 +517,8 @@ class RunQueue:
                 if len(group) >= max(1, int(policy.max_members)):
                     break
                 if not policy.may_coalesce(ticket):
+                    continue
+                if deferring and ticket.handle.priority >= Priority.BATCH:
                     continue
                 if self._at_active_quota_locked(
                     ticket.handle.tenant, taking
@@ -513,16 +576,23 @@ class RunQueue:
         max_priority: Optional[int] = None,
         should_stop: Optional[Callable[[], bool]] = None,
         policy: Optional[Any] = None,
+        defer_batch: Optional[Callable[[], bool]] = None,
     ) -> Optional[List[RunTicket]]:
         """Like :meth:`pop`, but returns the best live ticket TOGETHER
         with every compatible queued ticket the ``policy``
         (service.coalesce.CoalescePolicy) lets it absorb — the group
         that will share one superset scan. The caller owes one
         :meth:`task_done` per returned ticket. ``policy=None`` behaves
-        exactly like ``pop`` wrapped in a one-element list."""
+        exactly like ``pop`` wrapped in a one-element list.
+        ``defer_batch`` (preemption wiring) skips BATCH-class tickets
+        while it returns True — queued batch work yields to an
+        interactive ticket waiting on capacity without being started
+        and cancelled."""
         while True:
             with self._cond:
-                group = self._take_group_locked(max_priority, policy)
+                group = self._take_group_locked(
+                    max_priority, policy, defer_batch
+                )
                 if group:
                     get_telemetry().metrics.gauge(
                         "service.queue_depth"

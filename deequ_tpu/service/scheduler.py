@@ -24,6 +24,19 @@ injected, workers pop GROUPS of compatible tickets
 and the group shares one superset scan; every member keeps its own
 handle, timeline, events, and terminal transition — the fan-out below
 applies the exact same finish semantics per member as a solo run.
+
+Preemption (docs/SERVICE.md "Preemption and autoscaling"): with a
+``PreemptionController`` attached, every executing group is registered
+as a potential victim and an INTERACTIVE ticket that finds no free
+worker (or an exhausted device pool) preempts the youngest solo BATCH
+run. The worker owning the victim then routes through
+``_requeue_preempted`` instead of the terminal path: checkpoint
+evidence extracted, ``preempted`` journal record written, lease
+REVOKED rather than released, ticket requeued at its original seq.
+Autoscaling rides on the same plumbing: ``resize`` retargets the pool
+and workers re-read ``self.workers``/``self.interactive_reserve``
+every loop iteration, so scale-down is just a worker noticing its
+index is out of range.
 """
 
 from __future__ import annotations
@@ -32,6 +45,7 @@ import threading
 from typing import Any, Callable, List, Optional
 
 from deequ_tpu.engine.deadline import MonotonicClock
+from deequ_tpu.service.preempt import preempt_checkpoint_evidence
 from deequ_tpu.service.queue import (
     Priority,
     RunQueue,
@@ -61,6 +75,11 @@ class Scheduler:
         coalesce: Optional[Any] = None,
         placer: Optional[Any] = None,
         slo_tenants: Optional[Any] = None,
+        preemption: Optional[Any] = None,
+        on_preempted: Optional[
+            Callable[[RunTicket, Any], None]
+        ] = None,
+        on_resumed: Optional[Callable[[RunTicket], None]] = None,
     ):
         self.queue = queue
         self.execute = execute
@@ -85,8 +104,20 @@ class Scheduler:
         # tenants with an SLO objective get a per-tenant queue-wait
         # histogram (bounded cardinality: only configured tenants)
         self.slo_tenants = frozenset(slo_tenants or ())
+        # checkpoint-conserving preemption (service/preempt.py); None
+        # (the default) keeps every path below bit-identical to the
+        # pre-preemption scheduler
+        self.preemption = preemption
+        self.on_preempted = on_preempted
+        self.on_resumed = on_resumed
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._started = False
+        # worker occupancy + interactive capacity-wait accounting
+        # (preemption triggers and the batch-defer signal read these)
+        self._state_lock = threading.Lock()
+        self._busy = 0
+        self._capacity_waits = 0
 
     # -- lifecycle ------------------------------------------------------
 
@@ -94,27 +125,78 @@ class Scheduler:
         if self._threads:
             return
         self._stop.clear()
-        for i in range(self.workers):
-            reserved = i < self.interactive_reserve
-            # lint-ok: thread-discipline: pool workers are joined in
-            # Scheduler.stop(); registering them with the scan-scoped
-            # ingest probe would trip the between-scans leak assertion
-            thread = threading.Thread(
-                target=self._worker_loop,
-                args=(Priority.INTERACTIVE if reserved else None,),
-                daemon=True,
-                name=(
-                    f"deequ-tpu-service-{'reserve' if reserved else 'exec'}"
-                    f"-{i}"
-                ),
-            )
-            self._threads.append(thread)
-            thread.start()
+        self._started = True
+        self._spawn_to_target()
+
+    def _spawn_to_target(self) -> None:
+        """(Re)spawn worker threads so every index < ``self.workers``
+        has a live thread. Indices are stable identities: a worker
+        whose index falls out of range exits at its next loop check,
+        and a later scale-up respawns that index fresh."""
+        while len(self._threads) < self.workers:
+            self._threads.append(self._spawn(len(self._threads)))
+        for i in range(min(self.workers, len(self._threads))):
+            if not self._threads[i].is_alive():
+                self._threads[i] = self._spawn(i)
+
+    def _spawn(self, index: int) -> threading.Thread:
+        reserved = index < self.interactive_reserve
+        # lint-ok: thread-discipline: pool workers are joined in
+        # Scheduler.stop(); registering them with the scan-scoped
+        # ingest probe would trip the between-scans leak assertion
+        thread = threading.Thread(
+            target=self._worker_loop,
+            args=(index,),
+            daemon=True,
+            name=(
+                f"deequ-tpu-service-{'reserve' if reserved else 'exec'}"
+                f"-{index}"
+            ),
+        )
+        thread.start()
+        return thread
+
+    def resize(
+        self,
+        workers: Optional[int] = None,
+        interactive_reserve: Optional[int] = None,
+    ) -> None:
+        """Retarget the pool (the autoscaler's actuator — the single
+        writer of these targets after construction). Workers re-read
+        the targets every loop iteration: scale-up spawns threads
+        immediately, scale-down drains — an out-of-range worker
+        finishes its current group, then exits at the next pop. The
+        targets stay plain ints (atomic assignment; worker reads are
+        deliberately unlocked monitoring reads) — only the spawn
+        bookkeeping needs the lock."""
+        target_workers = (
+            self.workers if workers is None else max(1, int(workers))
+        )
+        target_reserve = (
+            self.interactive_reserve
+            if interactive_reserve is None
+            else max(0, int(interactive_reserve))
+        )
+        # at least one general worker must remain or BATCH/STANDARD
+        # work could never run at all
+        self.interactive_reserve = min(
+            target_reserve, target_workers - 1
+        )
+        self.workers = target_workers
+        with self._state_lock:
+            if self._started and not self._stop.is_set():
+                self._spawn_to_target()
+        tm = get_telemetry()
+        tm.metrics.gauge("service.workers").set(self.workers)
+        tm.metrics.gauge("service.interactive_reserve").set(
+            self.interactive_reserve
+        )
 
     def stop(self, timeout: Optional[float] = 10.0) -> None:
         """Stop taking new work and join the workers. Running tickets
         finish (the service cancels them first on a hard stop)."""
         self._stop.set()
+        self._started = False
         for thread in self._threads:
             thread.join(timeout=timeout)
         self._threads = [t for t in self._threads if t.is_alive()]
@@ -178,6 +260,22 @@ class Scheduler:
             queue_wait_s=round(wait_s, 6),
             coalesced=group_size > 1,
         )
+        if ticket.preemptions > 0:
+            # a preempted run starting again IS the resume: the durable
+            # cursor (keyed to source fingerprint + plan token, not the
+            # slice) picks the scan up past every completed batch
+            tm.counter("service.preempt_resumes").inc()
+            tm.event(
+                "service_run_resumed",
+                run_id=handle.run_id,
+                tenant=handle.tenant,
+                preemptions=ticket.preemptions,
+            )
+            if self.on_resumed is not None:
+                try:
+                    self.on_resumed(ticket)
+                except Exception:  # noqa: BLE001 — journaling must
+                    pass  # never block the resume itself
 
     def _finish_failed(self, ticket: RunTicket, exc: BaseException) -> None:
         tm = get_telemetry()
@@ -239,6 +337,103 @@ class Scheduler:
         else:
             self._finish_result(ticket, outcome)
 
+    # -- preemption -----------------------------------------------------
+
+    def note_interactive_demand(self, run_id: str) -> bool:
+        """An INTERACTIVE ticket just entered the queue; preempt the
+        youngest running solo BATCH group if nothing can serve it —
+        every worker busy, or the device pool exhausted. No-op (False)
+        without a controller."""
+        if self.preemption is None:
+            return False
+        with self._state_lock:
+            free_workers = self.workers - self._busy
+        if free_workers > 0 and self._pool_has_room():
+            return False
+        return self.preemption.preempt_for(run_id)
+
+    def _pool_has_room(self) -> bool:
+        if self.placer is None:
+            return True
+        try:
+            return self.placer.pool.free_count() > 0
+        except Exception:  # noqa: BLE001 — a placer without a pool
+            return True  # cannot signal exhaustion
+
+    def _defer_batch(self) -> bool:
+        """True while an INTERACTIVE group is blocked waiting for pool
+        capacity: queued BATCH tickets yield by skip (they stay queued,
+        untouched) instead of racing it into the pool only to be
+        cancel-preempted moments later."""
+        # lint-ok: lock-discipline: monitoring read of an int the
+        # capacity-wait scopes keep consistent; a stale read only
+        # delays/advances a batch pop by one poll tick
+        return self._capacity_waits > 0
+
+    def _requeue_preempted(self, ticket: RunTicket, outcome: Any) -> bool:
+        """The preemption finish path: if this attempt's outcome is
+        checkpoint-bearing cancel evidence (the preempt token fired and
+        the engine exited cleanly through its checkpoint path), journal
+        the preemption, requeue the ticket at its original seq, and
+        report True — the caller skips the terminal transition. Any
+        other outcome reports False and takes the normal path: a run
+        that completed before the cancel landed just finishes (its
+        work is NOT discarded), and a client cancel stays CANCELLED."""
+        evidence = preempt_checkpoint_evidence(ticket, outcome)
+        if evidence is None:
+            return False
+        tm = get_telemetry()
+        handle = ticket.handle
+        if getattr(evidence, "checkpointed", False):
+            # conservation credit: batches the durable cursor carries
+            # across the preemption (the resume will not re-scan them)
+            tm.counter("service.preempted_batches_conserved").inc(
+                max(0, int(getattr(evidence, "batch_index", 0)))
+            )
+        # write-ahead: the journal learns about the preemption BEFORE
+        # the ticket re-enters the queue, so a process death in between
+        # still recovers the run from the preemption record
+        if self.on_preempted is not None:
+            try:
+                self.on_preempted(ticket, evidence)
+            except Exception:  # noqa: BLE001 — journaling must never
+                pass  # lose the requeue
+        if not self.queue.requeue(ticket):
+            # queue closed under us (service stopping): nothing to
+            # resume into — apply normal terminal semantics instead
+            self._finish_outcome(ticket, outcome)
+            return True
+        tm.counter("service.preempt_requeues").inc()
+        tm.event(
+            "service_run_preempted",
+            run_id=handle.run_id,
+            tenant=handle.tenant,
+            priority=Priority.name(handle.priority),
+            reason=getattr(evidence, "reason", None),
+            batch_index=int(getattr(evidence, "batch_index", 0)),
+            row_offset=int(getattr(evidence, "row_offset", 0)),
+            checkpointed=bool(getattr(evidence, "checkpointed", False)),
+            preemptions=ticket.preemptions,
+        )
+        return True
+
+    def _release_lease(self, lease: Any, group: List[RunTicket]) -> None:
+        """Return the group's slice to the pool — via ``revoke`` (the
+        accounted preemption variant) when any member carries
+        checkpoint evidence, plain ``release`` otherwise."""
+        preempted = [
+            t
+            for t in group
+            if preempt_checkpoint_evidence(t) is not None
+        ]
+        if preempted and hasattr(self.placer, "revoke"):
+            self.placer.revoke(
+                lease,
+                run_ids=[t.handle.run_id for t in preempted],
+            )
+        else:
+            self.placer.release(lease)
+
     def _place_group(self, group: List[RunTicket]) -> Any:
         """Lease ONE device slice for the whole group (coalesced
         members run in one superset scan over the same dataset, so the
@@ -249,6 +444,31 @@ class Scheduler:
             (ticket.estimated_bytes or 0) for ticket in group
         )
         lead = group[0]
+        interactive = any(
+            t.handle.priority == Priority.INTERACTIVE for t in group
+        )
+        if (
+            self.preemption is not None
+            and interactive
+            and not self._pool_has_room()
+        ):
+            # the pool is exhausted at the moment an interactive group
+            # needs a slice: preempt NOW so the blocking place() below
+            # is bounded by one batch boundary, not a batch residency
+            self.preemption.preempt_for(lead.handle.run_id)
+        if self.preemption is not None and interactive:
+            with self._state_lock:
+                self._capacity_waits += 1
+            try:
+                return self._place_group_inner(group, estimated, lead)
+            finally:
+                with self._state_lock:
+                    self._capacity_waits -= 1
+        return self._place_group_inner(group, estimated, lead)
+
+    def _place_group_inner(
+        self, group: List[RunTicket], estimated: int, lead: RunTicket
+    ) -> Any:
         lease = self.placer.place(
             estimated_bytes=estimated,
             hint=(lead.dataset_key, lead.coalesce_surface),
@@ -323,44 +543,79 @@ class Scheduler:
 
     # -- the worker loop ------------------------------------------------
 
-    def _worker_loop(self, max_priority: Optional[int]) -> None:
+    def _worker_loop(self, index: int) -> None:
         while not self._stop.is_set():
+            # targets are re-read every iteration: resize() retargets
+            # and this worker reacts at its next pop (scale-down) or
+            # class restriction change (reserve adjustment)
+            if index >= self.workers:
+                return  # autoscaled away
+            max_priority = (
+                Priority.INTERACTIVE
+                if index < self.interactive_reserve
+                else None
+            )
             group = self.queue.pop_group(
                 max_priority=max_priority,
-                should_stop=self._stop.is_set,
+                should_stop=lambda: (
+                    self._stop.is_set() or index >= self.workers
+                ),
                 policy=self.coalesce,
+                defer_batch=(
+                    self._defer_batch
+                    if self.preemption is not None
+                    else None
+                ),
             )
             if group is None:
-                return  # queue closed or scheduler stopping
-            lease = None
-            if self.placer is not None:
-                try:
-                    lease = self._place_group(group)
-                # lint-ok: interrupt-swallow: same contract as the
-                # execute path below — a lease the group could not get
-                # in time (DeadlineExceeded/RunCancelled) terminates
-                # the members through their handles, not the worker
-                except BaseException as exc:  # noqa: BLE001
-                    for ticket in group:
-                        self._finish_failed(ticket, exc)
-                        self.queue.task_done(ticket)
-                    continue
-            for ticket in group:
-                self._mark_started(ticket, len(group))
+                if self._stop.is_set() or index >= self.workers:
+                    return  # stopping, or scaled down mid-wait
+                continue
+            with self._state_lock:
+                self._busy += 1
             try:
-                outcomes: List[Any] = self._run_group_traced(group)
-            # lint-ok: interrupt-swallow: the handles are the error
-            # channel — _finish(FAILED, error=exc) carries everything
-            # (interrupts included) to result(); the worker thread
-            # itself must survive any run
+                self._serve_group(group)
+            finally:
+                with self._state_lock:
+                    self._busy -= 1
+
+    def _serve_group(self, group: List[RunTicket]) -> None:
+        lease = None
+        record = None
+        if self.placer is not None:
+            try:
+                lease = self._place_group(group)
+            # lint-ok: interrupt-swallow: same contract as the
+            # execute path below — a lease the group could not get
+            # in time (DeadlineExceeded/RunCancelled) terminates
+            # the members through their handles, not the worker
             except BaseException as exc:  # noqa: BLE001
                 for ticket in group:
                     self._finish_failed(ticket, exc)
-            else:
-                for ticket, outcome in zip(group, outcomes):
-                    self._finish_outcome(ticket, outcome)
-            finally:
-                if lease is not None:
-                    self.placer.release(lease)
-                for ticket in group:
                     self.queue.task_done(ticket)
+                return
+        if self.preemption is not None:
+            record = self.preemption.register(group)
+        for ticket in group:
+            self._mark_started(ticket, len(group))
+        try:
+            outcomes: List[Any] = self._run_group_traced(group)
+        # lint-ok: interrupt-swallow: the handles are the error
+        # channel — _finish(FAILED, error=exc) carries everything
+        # (interrupts included) to result(); the worker thread
+        # itself must survive any run
+        except BaseException as exc:  # noqa: BLE001
+            for ticket in group:
+                if not self._requeue_preempted(ticket, exc):
+                    self._finish_failed(ticket, exc)
+        else:
+            for ticket, outcome in zip(group, outcomes):
+                if not self._requeue_preempted(ticket, outcome):
+                    self._finish_outcome(ticket, outcome)
+        finally:
+            if record is not None:
+                self.preemption.deregister(record)
+            if lease is not None:
+                self._release_lease(lease, group)
+            for ticket in group:
+                self.queue.task_done(ticket)

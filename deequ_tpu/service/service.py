@@ -51,6 +51,7 @@ from deequ_tpu.service.queue import (
     RunState,
     RunTicket,
 )
+from deequ_tpu.service.preempt import run_cancel_token
 from deequ_tpu.service.scheduler import Scheduler
 from deequ_tpu.telemetry import get_telemetry
 
@@ -145,6 +146,8 @@ class VerificationService:
         trace: Optional[bool] = None,
         metrics_port: Optional[int] = None,
         slo_objectives: Optional[str] = None,
+        preemption: Optional[bool] = None,
+        autoscale: Optional[bool] = None,
         process_label: str = "",
     ):
         from deequ_tpu import config
@@ -280,6 +283,23 @@ class VerificationService:
             from deequ_tpu.service.placement import ElasticPlacer
 
             self.placer = ElasticPlacer(clock=self.clock)
+        # checkpoint-conserving preemption (docs/SERVICE.md "Preemption
+        # and autoscaling"): opt-in; OFF (the default) keeps the
+        # scheduler/queue paths bit-identical to the pre-preemption
+        # service — no controller, no per-attempt tokens, no skips
+        preempt_on = bool(
+            opts.service_preemption if preemption is None else preemption
+        )
+        self.preemption = None
+        if preempt_on:
+            from deequ_tpu.service.preempt import PreemptionController
+
+            self.preemption = PreemptionController(
+                clock=self.clock,
+                max_preemptions_per_run=(
+                    opts.service_preempt_max_per_run
+                ),
+            )
         self.scheduler = Scheduler(
             self.queue,
             execute if execute is not None else self._execute,
@@ -300,7 +320,30 @@ class VerificationService:
                 if self.slo is not None
                 else None
             ),
+            preemption=self.preemption,
+            on_preempted=self._journal_preempted,
+            on_resumed=self._journal_resumed,
         )
+        # queue-driven autoscaling: the control loop over the per-class
+        # queue-wait histograms and SLO burn (service/autoscale.py)
+        autoscale_on = bool(
+            opts.service_autoscale if autoscale is None else autoscale
+        )
+        self.autoscaler: Optional[Any] = None
+        if autoscale_on:
+            from deequ_tpu.service.autoscale import AutoscaleController
+
+            self.autoscaler = AutoscaleController(
+                self.scheduler,
+                clock=self.clock,
+                interval_s=opts.service_autoscale_interval_s,
+                min_workers=opts.service_autoscale_min_workers,
+                max_workers=opts.service_autoscale_max_workers,
+                target_interactive_p99_s=(
+                    opts.service_autoscale_target_interactive_p99_s
+                ),
+                slo=self.slo,
+            )
         self._run_seq = 0
         self._handles: Dict[str, RunHandle] = {}
         self._handles_lock = threading.Lock()
@@ -326,6 +369,8 @@ class VerificationService:
             )
             self._sigterm_watcher.start()
         self.scheduler.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         if self._metrics_port is not None and self.metrics_server is None:
             from deequ_tpu.telemetry import serve_metrics
 
@@ -362,6 +407,8 @@ class VerificationService:
         if not drain:
             self.queue.drain_queued("service stopping")
         self._watcher_stop.set()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.scheduler.stop(timeout=timeout)
         if self.metrics_server is not None:
             self.metrics_server.close()
@@ -488,6 +535,14 @@ class VerificationService:
             dataset_key=request.dataset_key,
             deadline_s=request.deadline_s,
         )
+        if (
+            self.preemption is not None
+            and request.priority == Priority.INTERACTIVE
+        ):
+            # the admission IS the demand signal: if no worker (or no
+            # device slice) can serve this run, the youngest solo
+            # BATCH run yields at its next batch boundary
+            self.scheduler.note_interactive_demand(run_id)
         return handle
 
     # -- load shedding ---------------------------------------------------
@@ -565,6 +620,27 @@ class VerificationService:
             ),
         )
 
+    def _journal_preempted(self, ticket: RunTicket, evidence: Any) -> None:
+        """Write-ahead preemption record: lands BEFORE the ticket
+        re-enters the queue, so a process death in between still sees
+        the run as pending (and preempted) at recovery."""
+        if self.journal is None:
+            return
+        self.journal.record_preempted(
+            ticket.handle.run_id,
+            reason=getattr(evidence, "reason", None),
+            batch_index=int(getattr(evidence, "batch_index", 0) or 0),
+            row_offset=int(getattr(evidence, "row_offset", 0) or 0),
+            checkpointed=bool(getattr(evidence, "checkpointed", False)),
+        )
+
+    def _journal_resumed(self, ticket: RunTicket) -> None:
+        if self.journal is None:
+            return
+        self.journal.record_resumed(
+            ticket.handle.run_id, preemptions=int(ticket.preemptions)
+        )
+
     # -- restart recovery ------------------------------------------------
 
     def recover(
@@ -626,6 +702,8 @@ class VerificationService:
                 tenant=entry.get("tenant"),
                 started=bool(entry.get("started")),
                 last_checkpoint=entry.get("last_checkpoint"),
+                preempted=bool(entry.get("preempted")),
+                preempt_count=int(entry.get("preempt_count") or 0),
             )
         if recovered:
             tm.counter("service.runs_recovered").inc(len(recovered))
@@ -739,7 +817,7 @@ class VerificationService:
                 metrics_repository=request.metrics_repository,
                 save_or_append_results_with_key=request.result_key,
                 deadline=ticket.budget,
-                cancel=ticket.handle.cancel_token,
+                cancel=run_cancel_token(ticket),
                 row_level_sink=request.row_level_sink,
             )
         finally:
@@ -816,6 +894,10 @@ class VerificationService:
                 else None
             ),
             clock=self.clock,
+            # preemption (and client cancel) crosses the spawn boundary
+            # as ONE control message; the child exits cleanly through
+            # its checkpoint path — never terminated mid-batch
+            cancel_token=run_cancel_token(ticket),
         )
         try:
             result = runner.run(_isolated_execute, payload)
@@ -1102,6 +1184,23 @@ class VerificationService:
             placement = self.placer.snapshot()
             payload["placement"] = placement
             payload["slices_active"] = placement.get("active_slices")
+        if self.preemption is not None:
+            preempt = self.preemption.snapshot()
+            preempt["preemptions"] = counters.get(
+                "service.preemptions", 0
+            )
+            preempt["requeues"] = counters.get(
+                "service.preempt_requeues", 0
+            )
+            preempt["resumes"] = counters.get(
+                "service.preempt_resumes", 0
+            )
+            preempt["batches_conserved"] = counters.get(
+                "service.preempted_batches_conserved", 0
+            )
+            payload["preemption"] = preempt
+        if self.autoscaler is not None:
+            payload["autoscale"] = self.autoscaler.snapshot()
         if self.slo is not None:
             payload["slo"] = self.slo.snapshot()
         return payload
@@ -1171,7 +1270,11 @@ def _isolated_execute(payload: Dict[str, Any]):
     its factory, attaches a checkpointer over the service's durable
     checkpoint path — so a relaunched child resumes mid-scan — and
     strips ``_data`` from the result (device buffers do not cross the
-    pipe; row-level export needs an in-process run)."""
+    pipe; row-level export needs an in-process run). The run listens on
+    the child-side cancel token: a parent-sent preemption (or client
+    cancel) exits the scan cleanly at the next batch boundary, final
+    cursor persisted."""
+    from deequ_tpu.engine.subproc import child_cancel_token
     from deequ_tpu.verification.suite import VerificationSuite
 
     engine = _child_engine(payload)
@@ -1182,6 +1285,7 @@ def _isolated_execute(payload: Dict[str, Any]):
         required_analyzers=payload["required_analyzers"],
         engine=engine,
         deadline=payload.get("deadline_s"),
+        cancel=child_cancel_token(),
     )
     result._data = None
     return result

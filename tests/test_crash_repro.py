@@ -1,0 +1,81 @@
+"""The crash-repro bisection core (tools/crash_repro.py) — pure-logic
+tests with a scripted probe; no children are ever spawned."""
+
+from tools.crash_repro import BASE_CONFIG, MIN_BATCH, MIN_ROWS, bisect_crash
+
+
+class _Probe:
+    """Deterministic probe: ``rule(cfg) -> bool`` decides the crash."""
+
+    def __init__(self, rule):
+        self.rule = rule
+        self.calls = []
+
+    def __call__(self, cfg):
+        self.calls.append(dict(cfg))
+        return {"crashed": bool(self.rule(cfg))}
+
+
+def test_no_crash_at_baseline_short_circuits():
+    probe = _Probe(lambda cfg: False)
+    verdict = bisect_crash(probe)
+    assert verdict["reproduced"] is False
+    assert verdict["narrowest"] is None
+    assert verdict["xla_cache_implicated"] is False
+    assert len(probe.calls) == 1  # baseline only, no bisection
+    assert verdict["baseline"] == BASE_CONFIG
+
+
+def test_cache_implicated_when_cache_off_stops_crashing():
+    # crash needs the cache AND a big-enough batch AND enough rows
+    def rule(cfg):
+        return (
+            cfg["xla_cache"]
+            and cfg["batch_size"] >= (1 << 18)
+            and cfg["rows"] >= 250_000
+        )
+
+    verdict = bisect_crash(_Probe(rule))
+    assert verdict["reproduced"] is True
+    assert verdict["xla_cache_implicated"] is True
+    narrowest = verdict["narrowest"]
+    # the cache stays ON in the narrowest config (turning it off left
+    # the reproducing family), and every other dimension is minimal
+    assert narrowest["xla_cache"] is True
+    assert narrowest["batch_size"] == 1 << 18
+    assert narrowest["rows"] == 250_000
+    assert narrowest["ingest_workers"] == 1  # serial path still crashes
+    # the narrowest config was actually observed to crash
+    labels = [t["label"] for t in verdict["trials"]]
+    assert labels[0] == "baseline"
+    assert "xla_cache_off" in labels
+
+
+def test_cache_innocent_keeps_cache_off_as_narrower():
+    verdict = bisect_crash(_Probe(lambda cfg: True))
+    assert verdict["xla_cache_implicated"] is False
+    narrowest = verdict["narrowest"]
+    # crashes either way, so cache-off is the narrower claim
+    assert narrowest["xla_cache"] is False
+    # always-crash bottoms out at the floors, and terminates
+    assert narrowest["batch_size"] >= MIN_BATCH
+    assert narrowest["batch_size"] < 2 * MIN_BATCH
+    assert narrowest["rows"] >= MIN_ROWS
+    assert narrowest["rows"] < 2 * MIN_ROWS
+
+
+def test_serial_ingest_not_kept_when_it_stops_crashing():
+    # crash requires parallel ingest (workers != 1)
+    verdict = bisect_crash(_Probe(lambda cfg: cfg["ingest_workers"] != 1))
+    assert verdict["reproduced"] is True
+    assert verdict["narrowest"]["ingest_workers"] == BASE_CONFIG[
+        "ingest_workers"
+    ]
+
+
+def test_trial_log_carries_probe_outcome():
+    probe = _Probe(lambda cfg: False)
+    bisect = bisect_crash(probe, dict(BASE_CONFIG, rows=123_456))
+    trial = bisect["trials"][0]
+    assert trial["config"]["rows"] == 123_456
+    assert trial["outcome"] == {"crashed": False}

@@ -1,0 +1,1018 @@
+"""Checkpoint-conserving preemption + queue-driven autoscaling
+(docs/SERVICE.md "Preemption and autoscaling"): evidence gating, the
+victim policy, requeue/resume semantics, journal recovery across a
+kill, the real-engine bit-equality differential, and the autoscale
+control loop — scheduling behavior on stub executors and fake clocks,
+engine behavior on small real datasets."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu.engine.deadline import (
+    ManualClock,
+    RunCancelled,
+    ScanInterruption,
+)
+from deequ_tpu.service import (
+    AutoscaleController,
+    Priority,
+    PreemptionController,
+    RunHandle,
+    RunJournal,
+    RunQueue,
+    RunRequest,
+    RunState,
+    RunTicket,
+    VerificationService,
+    preempt_checkpoint_evidence,
+    run_cancel_token,
+)
+from deequ_tpu.service.autoscale import (
+    BATCH_WAIT,
+    IDLE_ROUNDS_BEFORE_SCALE_DOWN,
+    INTERACTIVE_WAIT,
+    interval_p99,
+)
+from deequ_tpu.service.coalesce import CoalescePolicy
+from deequ_tpu.service.preempt import is_preempt_reason, preempt_reason
+from deequ_tpu.telemetry import get_telemetry
+
+
+def _ticket(priority=Priority.BATCH, run_id="run-x", seq=0, tenant="acme"):
+    handle = RunHandle(run_id, tenant, priority)
+    return RunTicket(seq=seq, handle=handle, payload=None, budget=None)
+
+
+def _spin_until(predicate, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+def _count(name):
+    return get_telemetry().counter(name).value
+
+
+def _events(name):
+    return [
+        e for e in get_telemetry().recent() if e.get("event") == name
+    ]
+
+
+class _FakeResult:
+    def __init__(self, interruption=None):
+        self.interruption = interruption
+        self.telemetry = None
+        self.metrics = {}
+
+
+def _preempted_result(token, checkpointed=True, batch_index=3):
+    return _FakeResult(
+        interruption=ScanInterruption(
+            kind="cancelled",
+            reason=token.reason or "",
+            batch_index=batch_index,
+            row_offset=batch_index * 1000,
+            checkpointed=checkpointed,
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# evidence gating (preempt_checkpoint_evidence)
+# ---------------------------------------------------------------------------
+
+
+class TestEvidence:
+    def _armed(self):
+        ticket = _ticket()
+        controller = PreemptionController(clock=ManualClock())
+        record = controller.register([ticket])
+        assert controller.preempt_for("demand-1")
+        return ticket, controller, record
+
+    def test_no_request_means_no_evidence(self):
+        ticket = _ticket()
+        PreemptionController(clock=ManualClock()).register([ticket])
+        outcome = _FakeResult(
+            interruption=ScanInterruption(
+                kind="cancelled",
+                reason=preempt_reason("run-x", "d"),
+                checkpointed=True,
+            )
+        )
+        assert preempt_checkpoint_evidence(ticket, outcome) is None
+
+    def test_preempt_cancel_interruption_is_evidence_and_cached(self):
+        ticket, _c, _r = self._armed()
+        outcome = _preempted_result(ticket.preempt_token)
+        evidence = preempt_checkpoint_evidence(ticket, outcome)
+        assert evidence is outcome.interruption
+        assert evidence.checkpointed is True
+        # the no-outcome form reads the cached verdict (the lease
+        # revocation call site relies on this)
+        assert preempt_checkpoint_evidence(ticket) is evidence
+
+    def test_user_cancel_wins_over_preemption(self):
+        ticket, _c, _r = self._armed()
+        ticket.handle.cancel_token.cancel("changed my mind")
+        outcome = _preempted_result(ticket.preempt_token)
+        assert preempt_checkpoint_evidence(ticket, outcome) is None
+
+    def test_precancel_runcancelled_yields_unchecked_evidence(self):
+        ticket, _c, _r = self._armed()
+        exc = RunCancelled(ticket.preempt_token.reason)
+        evidence = preempt_checkpoint_evidence(ticket, exc)
+        assert evidence is not None
+        assert evidence.checkpointed is False
+        assert evidence.batch_index == 0
+
+    def test_foreign_cancel_reason_is_not_evidence(self):
+        ticket, _c, _r = self._armed()
+        outcome = _FakeResult(
+            interruption=ScanInterruption(
+                kind="cancelled", reason="deadline shim", checkpointed=True
+            )
+        )
+        assert preempt_checkpoint_evidence(ticket, outcome) is None
+
+    def test_reason_roundtrip(self):
+        reason = preempt_reason("victim-1", "demand-9")
+        assert is_preempt_reason(reason)
+        assert "victim-1" in reason and "demand-9" in reason
+        assert not is_preempt_reason("cancelled")
+        assert not is_preempt_reason(None)
+
+
+# ---------------------------------------------------------------------------
+# victim policy (PreemptionController)
+# ---------------------------------------------------------------------------
+
+
+class TestVictimPolicy:
+    def test_solo_batch_is_eligible_and_token_fires(self):
+        clock = ManualClock()
+        controller = PreemptionController(clock=clock)
+        ticket = _ticket(run_id="victim")
+        controller.register([ticket])
+        before = _count("service.preemptions")
+        assert controller.preempt_for("needy") is True
+        assert ticket.preempt_requested is True
+        assert ticket.preemptions == 1
+        assert ticket.preempt_token.cancelled
+        assert is_preempt_reason(ticket.preempt_token.reason)
+        # the handle's own token is untouched: only this attempt dies
+        assert not ticket.handle.cancel_token.cancelled
+        assert _count("service.preemptions") == before + 1
+        # an already-requested victim is not preempted twice
+        assert controller.preempt_for("needy-2") is False
+
+    def test_coalesced_group_is_never_a_victim(self):
+        controller = PreemptionController(clock=ManualClock())
+        group = [
+            _ticket(run_id="m1"),
+            _ticket(run_id="m2", seq=1),
+        ]
+        controller.register(group)
+        assert controller.preempt_for("needy") is False
+
+    def test_interactive_run_is_never_a_victim(self):
+        controller = PreemptionController(clock=ManualClock())
+        controller.register(
+            [_ticket(priority=Priority.INTERACTIVE, run_id="i")]
+        )
+        assert controller.preempt_for("needy") is False
+
+    def test_youngest_victim_chosen(self):
+        clock = ManualClock()
+        controller = PreemptionController(clock=clock)
+        old = _ticket(run_id="old")
+        controller.register([old])
+        clock.advance(5.0)
+        young = _ticket(run_id="young", seq=7)
+        controller.register([young])
+        assert controller.preempt_for("needy") is True
+        assert young.preempt_requested and not old.preempt_requested
+
+    def test_max_preemptions_bounds_livelock(self):
+        controller = PreemptionController(
+            clock=ManualClock(), max_preemptions_per_run=2
+        )
+        ticket = _ticket(run_id="twice")
+        ticket.preemptions = 2
+        controller.register([ticket])
+        # at the bound the run is no longer a victim: it runs to
+        # completion however long interactive pressure lasts
+        assert controller.preempt_for("needy") is False
+
+    def test_deregister_removes_group(self):
+        controller = PreemptionController(clock=ManualClock())
+        record = controller.register([_ticket()])
+        controller.deregister(record)
+        assert controller.preempt_for("needy") is False
+        assert controller.snapshot()["running_groups"] == 0
+
+
+# ---------------------------------------------------------------------------
+# queue requeue semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRequeue:
+    def test_requeue_preserves_seq_restamps_submit(self):
+        clock = ManualClock()
+        q = RunQueue(clock=clock)
+        ticket = _ticket(run_id="back")
+        q.push(ticket)
+        seq_at_submit = ticket.seq  # the queue stamps seq at push
+        popped = q.pop(should_stop=lambda: True)
+        assert popped is ticket
+        clock.advance(9.0)
+        assert q.requeue(ticket) is True
+        assert ticket.seq == seq_at_submit  # place in line is conserved
+        assert ticket.submitted_at == clock.now()  # new wait leg
+        assert ticket.handle.status == RunState.QUEUED
+        again = q.pop(should_stop=lambda: True)
+        assert again is ticket
+
+    def test_requeued_resumes_ahead_of_later_batch(self):
+        q = RunQueue(clock=ManualClock())
+        victim = _ticket(run_id="victim", seq=1)
+        q.push(victim)
+        assert q.pop(should_stop=lambda: True) is victim
+        later = _ticket(run_id="later", seq=2)
+        q.push(later)
+        q.requeue(victim)
+        # original seq orders the victim ahead of anything submitted
+        # after it — preemption changes WHEN it runs, not its place
+        assert q.pop(should_stop=lambda: True) is victim
+
+    def test_requeue_into_closed_queue_fails(self):
+        q = RunQueue(clock=ManualClock())
+        ticket = _ticket(run_id="late")
+        q.push(ticket)
+        q.pop(should_stop=lambda: True)
+        q.close()
+        assert q.requeue(ticket) is False
+
+
+# ---------------------------------------------------------------------------
+# service-level preempt -> requeue -> resume (stub executors)
+# ---------------------------------------------------------------------------
+
+
+class TestServicePreemption:
+    def _request(self, tenant="acme", priority=Priority.BATCH,
+                 dataset_key="shared"):
+        return RunRequest(
+            tenant=tenant,
+            checks=(),
+            dataset_key=dataset_key,
+            dataset_factory=lambda: None,
+            priority=priority,
+        )
+
+    def _preemptable_execute(self, resume_release=None):
+        """BATCH first attempts block until preempted; resumed
+        attempts (and INTERACTIVE runs) complete immediately, unless
+        ``resume_release`` gates the resumed leg too."""
+
+        def execute(ticket):
+            token = run_cancel_token(ticket)
+            if ticket.handle.priority >= Priority.BATCH:
+                if ticket.preemptions == 0:
+                    assert token.wait(timeout=30)
+                    return _preempted_result(token)
+                if resume_release is not None:
+                    assert resume_release.wait(timeout=30)
+                    if token.cancelled:
+                        return _preempted_result(token)
+            return _FakeResult()
+
+        return execute
+
+    def test_full_preempt_requeue_resume_cycle(self, tmp_path):
+        before = {
+            name: _count(name)
+            for name in (
+                "service.preemptions",
+                "service.preempt_requeues",
+                "service.preempt_resumes",
+                "service.preempted_batches_conserved",
+            )
+        }
+        svc = VerificationService(
+            workers=1, clock=ManualClock(),
+            execute=self._preemptable_execute(),
+            preemption=True, journal_dir=str(tmp_path),
+        ).start()
+        try:
+            batch = svc.submit(self._request(priority=Priority.BATCH))
+            assert _spin_until(lambda: batch.status == RunState.RUNNING)
+            quick = svc.submit(
+                self._request(
+                    tenant="globex", priority=Priority.INTERACTIVE,
+                    dataset_key="q",
+                )
+            )
+            # the interactive run preempts through the saturated pool
+            assert quick.wait(timeout=15)
+            assert quick.status == RunState.DONE
+            # the victim resumes and completes
+            assert batch.wait(timeout=15)
+            assert batch.status == RunState.DONE
+            assert batch.result(timeout=0).interruption is None
+        finally:
+            svc.stop(drain=False, timeout=10)
+        assert _count("service.preemptions") == before[
+            "service.preemptions"
+        ] + 1
+        assert _count("service.preempt_requeues") == before[
+            "service.preempt_requeues"
+        ] + 1
+        assert _count("service.preempt_resumes") == before[
+            "service.preempt_resumes"
+        ] + 1
+        # the stub's evidence said batch_index=3: three batches crossed
+        # the preemption without recompute
+        assert _count("service.preempted_batches_conserved") == before[
+            "service.preempted_batches_conserved"
+        ] + 3
+        # the decision trail: requested -> preempted -> resumed
+        assert _events("service_run_preempt_requested")
+        assert _events("service_run_preempted")
+        assert _events("service_run_resumed")
+        # the journal holds the write-ahead bracket in order
+        journal = RunJournal(str(tmp_path))
+        types = [r["type"] for r in journal.replay()
+                 if r.get("run_id") == batch.run_id]
+        assert "preempted" in types and "resumed" in types
+        assert types.index("preempted") < types.index("resumed")
+        assert types[-1] == "terminal"
+
+    def test_queued_batch_is_not_preempted(self):
+        release = threading.Event()
+
+        def execute(ticket):
+            if ticket.handle.priority == Priority.STANDARD:
+                assert release.wait(timeout=30)
+            return _FakeResult()
+
+        before = _count("service.preemptions")
+        svc = VerificationService(
+            workers=1, clock=ManualClock(), execute=execute,
+            preemption=True,
+        ).start()
+        try:
+            blocker = svc.submit(
+                self._request(priority=Priority.STANDARD)
+            )
+            assert _spin_until(
+                lambda: blocker.status == RunState.RUNNING
+            )
+            # a QUEUED batch holds no capacity: it yields by skip, not
+            # by cancellation, and is never a preemption victim
+            parked = svc.submit(
+                self._request(priority=Priority.BATCH, dataset_key="b")
+            )
+            quick = svc.submit(
+                self._request(
+                    tenant="globex", priority=Priority.INTERACTIVE,
+                    dataset_key="q",
+                )
+            )
+            # the running STANDARD group is not eligible either — the
+            # interactive run waits its turn, nothing is preempted
+            assert _count("service.preemptions") == before
+            release.set()
+            assert quick.wait(timeout=15)
+            assert parked.wait(timeout=15)
+            assert quick.status == RunState.DONE
+            assert parked.status == RunState.DONE
+        finally:
+            release.set()
+            svc.stop(drain=False, timeout=10)
+        assert _count("service.preemptions") == before
+
+    def test_preemption_cap_then_runs_to_completion(self):
+        from deequ_tpu import config
+
+        resume_release = threading.Event()
+        before = _count("service.preemptions")
+        with config.configure(service_preempt_max_per_run=1):
+            svc = VerificationService(
+                workers=1, clock=ManualClock(),
+                execute=self._preemptable_execute(resume_release),
+                preemption=True,
+            ).start()
+        try:
+            batch = svc.submit(self._request(priority=Priority.BATCH))
+            assert _spin_until(lambda: batch.status == RunState.RUNNING)
+            first = svc.submit(
+                self._request(
+                    tenant="globex", priority=Priority.INTERACTIVE,
+                    dataset_key="q1",
+                )
+            )
+            assert first.wait(timeout=15)
+            assert _count("service.preemptions") == before + 1
+            # the victim is resuming (blocked on resume_release); at
+            # the cap it is ineligible: a second interactive demand
+            # preempts nothing and waits behind it
+            assert _spin_until(lambda: batch.status == RunState.RUNNING)
+            second = svc.submit(
+                self._request(
+                    tenant="globex", priority=Priority.INTERACTIVE,
+                    dataset_key="q2",
+                )
+            )
+            assert not second.wait(timeout=0.3)
+            assert _count("service.preemptions") == before + 1
+            resume_release.set()
+            assert batch.wait(timeout=15)
+            assert second.wait(timeout=15)
+            assert batch.status == RunState.DONE
+            assert second.status == RunState.DONE
+        finally:
+            resume_release.set()
+            svc.stop(drain=False, timeout=10)
+
+    def test_user_cancel_terminates_not_requeues(self):
+        def execute(ticket):
+            token = run_cancel_token(ticket)
+            if ticket.handle.priority == Priority.BATCH:
+                assert token.wait(timeout=30)
+                return _FakeResult(
+                    interruption=ScanInterruption(
+                        kind="cancelled",
+                        reason=token.reason or "",
+                        checkpointed=True,
+                    )
+                )
+            return _FakeResult()
+
+        before = _count("service.preempt_requeues")
+        svc = VerificationService(
+            workers=1, clock=ManualClock(), execute=execute,
+            preemption=True,
+        ).start()
+        try:
+            batch = svc.submit(self._request(priority=Priority.BATCH))
+            assert _spin_until(lambda: batch.status == RunState.RUNNING)
+            batch.cancel("changed my mind")
+            assert batch.wait(timeout=15)
+            # a client cancel rides the handle token THROUGH the
+            # per-attempt preempt token: the run terminates CANCELLED
+            # with its partial result — it is not silently requeued
+            assert batch.status == RunState.CANCELLED
+        finally:
+            svc.stop(drain=False, timeout=10)
+        assert _count("service.preempt_requeues") == before
+
+    def test_off_by_default_is_inert(self):
+        seen = {}
+
+        def execute(ticket):
+            seen["token_is_handle"] = (
+                run_cancel_token(ticket) is ticket.handle.cancel_token
+            )
+            seen["preempt_token"] = ticket.preempt_token
+            return _FakeResult()
+
+        svc = VerificationService(
+            workers=1, clock=ManualClock(), execute=execute,
+        ).start()
+        try:
+            assert svc.preemption is None
+            assert svc.autoscaler is None
+            assert svc.scheduler.preemption is None
+            handle = svc.submit(self._request())
+            assert handle.wait(timeout=15)
+            assert handle.status == RunState.DONE
+            # no controller, no per-attempt tokens: the executor sees
+            # bit-for-bit the pre-preemption cancel plumbing
+            assert seen["token_is_handle"] is True
+            assert seen["preempt_token"] is None
+            assert "preemption" not in svc.health()
+            assert "autoscale" not in svc.health()
+        finally:
+            svc.stop(drain=False, timeout=10)
+
+    def test_health_reports_preemption_plane(self):
+        svc = VerificationService(
+            workers=1, clock=ManualClock(),
+            execute=lambda t: _FakeResult(),
+            preemption=True, autoscale=True,
+        ).start()
+        try:
+            payload = svc.health()
+            assert payload["preemption"]["running_groups"] == 0
+            assert "preemptions" in payload["preemption"]
+            assert payload["autoscale"]["workers"] == 1
+        finally:
+            svc.stop(drain=False, timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# journal bracket + kill-between-preempt-and-resume recovery
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionRecovery:
+    def test_pending_runs_tracks_preemption_bracket(self, tmp_path):
+        journal = RunJournal(str(tmp_path))
+        journal.record_submitted(
+            "r1", tenant="acme", priority=Priority.BATCH
+        )
+        journal.record_started("r1")
+        journal.record_preempted(
+            "r1", reason=preempt_reason("r1", "d"),
+            batch_index=4, row_offset=4096, checkpointed=True,
+        )
+        entry = journal.pending_runs()["r1"]
+        assert entry["preempted"] is True
+        assert entry["preempt_count"] == 1
+        assert entry["last_preemption"]["batch_index"] == 4
+        journal.record_resumed("r1", preemptions=1)
+        entry = journal.pending_runs()["r1"]
+        assert entry["preempted"] is False
+        assert entry["preempt_count"] == 1
+        journal.record_terminal("r1", "done")
+        assert "r1" not in journal.pending_runs()
+
+    def test_killed_between_preempt_and_resume_recovers(self, tmp_path):
+        # the dead service got exactly this far: victim preempted,
+        # write-ahead record landed, process died BEFORE the requeued
+        # ticket executed — no resumed record, no terminal record
+        dead = RunJournal(str(tmp_path))
+        dead.record_submitted(
+            "victim-1", tenant="acme", priority=Priority.BATCH,
+            dataset_key="shared",
+        )
+        dead.record_started("victim-1")
+        dead.record_preempted(
+            "victim-1", reason=preempt_reason("victim-1", "demand"),
+            batch_index=7, row_offset=7168, checkpointed=True,
+        )
+
+        seen = {}
+
+        def resolve(run_id, entry):
+            seen[run_id] = entry
+            return RunRequest(
+                tenant=entry["tenant"],
+                checks=(),
+                dataset_key=entry.get("dataset_key"),
+                dataset_factory=lambda: None,
+                priority=entry.get("priority", Priority.BATCH),
+            )
+
+        svc = VerificationService(
+            workers=1, clock=ManualClock(),
+            execute=lambda t: _FakeResult(),
+            preemption=True, journal_dir=str(tmp_path),
+        )
+        handles = svc.recover(resolve)
+        svc.start()
+        try:
+            assert [h.run_id for h in handles] == ["victim-1"]
+            # the resolver saw the preemption bracket: the run is
+            # recovered as preempted-not-yet-resumed
+            assert seen["victim-1"]["preempted"] is True
+            assert seen["victim-1"]["last_preemption"][
+                "batch_index"
+            ] == 7
+            assert handles[0].wait(timeout=15)
+            assert handles[0].status == RunState.DONE
+        finally:
+            svc.stop(drain=False, timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# autoscaling control loop
+# ---------------------------------------------------------------------------
+
+
+class _FakeScheduler:
+    def __init__(self, workers=1, interactive_reserve=0, window_s=0.0):
+        self.workers = workers
+        self.interactive_reserve = interactive_reserve
+        self.coalesce = CoalescePolicy(
+            enabled=window_s > 0, window_s=window_s
+        )
+        self.queue = self
+        self.resizes = []
+
+    def depth(self):
+        return 0
+
+    def resize(self, workers=None, interactive_reserve=None):
+        target = self.workers if workers is None else max(1, int(workers))
+        reserve = (
+            self.interactive_reserve
+            if interactive_reserve is None
+            else max(0, int(interactive_reserve))
+        )
+        self.interactive_reserve = min(reserve, target - 1)
+        self.workers = target
+        self.resizes.append((self.workers, self.interactive_reserve))
+
+
+class TestAutoscale:
+    def test_interval_p99_diffs_cumulative_snapshots(self):
+        prev = {"count": 10, "max": 2.0, "buckets": {0.1: 8, 1.0: 10}}
+        cur = {"count": 110, "max": 2.0, "buckets": {0.1: 9, 1.0: 110}}
+        # 100 interval observations, 99% of them under the 1.0 bound
+        assert interval_p99(prev, cur) == 1.0
+        assert interval_p99(cur, cur) is None  # empty interval
+        assert interval_p99(None, prev) == 1.0
+
+    def test_interval_p99_beyond_top_bucket_uses_max(self):
+        cur = {"count": 5, "max": 42.0, "buckets": {0.1: 0, 1.0: 0}}
+        assert interval_p99(None, cur) == 42.0
+
+    def test_scale_up_on_interactive_pressure(self):
+        sched = _FakeScheduler(workers=1)
+        ctl = AutoscaleController(
+            sched, clock=ManualClock(), max_workers=4,
+            target_interactive_p99_s=0.5,
+        )
+        ctl.step()  # baseline: absorb whatever history the registry holds
+        hist = get_telemetry().metrics.histogram(INTERACTIVE_WAIT)
+        for _ in range(5):
+            hist.observe(3.0)
+        adjustments = ctl.step()
+        assert sched.workers == 2
+        assert sched.interactive_reserve == 1
+        knobs = {a["knob"] for a in adjustments}
+        assert "workers" in knobs and "interactive_reserve" in knobs
+        assert all("reason" in a for a in adjustments)
+        # one notch per decision, not a jump to max
+        assert sched.workers < 4
+
+    def test_scale_down_needs_consecutive_idle_rounds(self):
+        sched = _FakeScheduler(workers=3)
+        ctl = AutoscaleController(
+            sched, clock=ManualClock(), min_workers=1, max_workers=4
+        )
+        ctl.step()  # baseline
+        for _ in range(IDLE_ROUNDS_BEFORE_SCALE_DOWN - 1):
+            assert ctl.step() == []
+            assert sched.workers == 3  # hysteresis holds
+        adjustments = ctl.step()
+        assert sched.workers == 2
+        assert adjustments[0]["knob"] == "workers"
+
+    def test_pressure_resets_idle_hysteresis(self):
+        sched = _FakeScheduler(workers=2)
+        ctl = AutoscaleController(
+            sched, clock=ManualClock(), max_workers=4,
+            target_interactive_p99_s=0.5,
+        )
+        ctl.step()
+        ctl.step()  # idle round 1
+        get_telemetry().metrics.histogram(INTERACTIVE_WAIT).observe(9.0)
+        ctl.step()  # pressure: scales up AND resets the idle streak
+        assert sched.workers == 3
+        for _ in range(IDLE_ROUNDS_BEFORE_SCALE_DOWN - 1):
+            ctl.step()
+        assert sched.workers == 3  # not enough idle rounds yet
+
+    def test_window_shrinks_under_batch_starvation_and_restores(self):
+        sched = _FakeScheduler(workers=2, window_s=0.2)
+        ctl = AutoscaleController(sched, clock=ManualClock())
+        ctl.step()  # baseline
+        hist = get_telemetry().metrics.histogram(BATCH_WAIT)
+        for _ in range(4):
+            hist.observe(5.0)  # p99 >> 4x the 0.2s window
+        adjustments = ctl.step()
+        assert sched.coalesce.window_s == pytest.approx(0.1)
+        assert any(
+            a["knob"] == "coalesce_window_s" for a in adjustments
+        )
+        # waits subside -> the window doubles back toward its base,
+        # never past it
+        ctl.step()
+        assert sched.coalesce.window_s == pytest.approx(0.2)
+        ctl.step()
+        assert sched.coalesce.window_s == pytest.approx(0.2)
+
+    def test_autoscale_emits_decision_events(self):
+        sched = _FakeScheduler(workers=1)
+        ctl = AutoscaleController(
+            sched, clock=ManualClock(), max_workers=2,
+            target_interactive_p99_s=0.1,
+        )
+        before = _count("service.autoscale_adjustments")
+        ctl.step()
+        get_telemetry().metrics.histogram(INTERACTIVE_WAIT).observe(7.0)
+        ctl.step()
+        assert _count("service.autoscale_adjustments") > before
+        events = _events("autoscale_adjustment")
+        assert events
+        latest = events[-1]
+        assert {"knob", "from_value", "to_value", "reason", "at"} <= set(
+            latest
+        )
+
+    def test_respects_worker_bounds(self):
+        sched = _FakeScheduler(workers=3)
+        ctl = AutoscaleController(
+            sched, clock=ManualClock(), min_workers=3, max_workers=3,
+            target_interactive_p99_s=0.1,
+        )
+        ctl.step()
+        get_telemetry().metrics.histogram(INTERACTIVE_WAIT).observe(8.0)
+        ctl.step()  # pressure, but already at max
+        assert sched.workers == 3
+        for _ in range(IDLE_ROUNDS_BEFORE_SCALE_DOWN + 1):
+            ctl.step()  # idle, but already at min
+        assert sched.workers == 3
+
+    def test_live_service_runs_the_loop(self):
+        svc = VerificationService(
+            workers=1, execute=lambda t: _FakeResult(),
+            preemption=True, autoscale=True,
+        )
+        svc.start()
+        try:
+            assert svc.autoscaler is not None
+            assert svc.autoscaler._thread is not None
+            assert svc.autoscaler._thread.is_alive()
+        finally:
+            svc.stop(drain=False, timeout=10)
+        assert not svc.autoscaler._thread
+
+
+# ---------------------------------------------------------------------------
+# real engine: preempted-then-resumed == uninterrupted, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _fingerprint(result):
+    return tuple(
+        sorted(
+            (str(analyzer), repr(getattr(metric, "value", metric)))
+            for analyzer, metric in dict(result.metrics).items()
+        )
+    )
+
+
+class TestRealEngineDifferential:
+    ROWS = 200_000
+
+    def _make_dataset(self):
+        import pyarrow as pa
+
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(23)
+        return Dataset.from_arrow(
+            pa.table(
+                {
+                    "k1": rng.integers(
+                        0, 1 << 40, self.ROWS, dtype=np.int64
+                    ),
+                    "v1": rng.normal(0, 1, self.ROWS).astype(
+                        np.float32
+                    ),
+                }
+            )
+        )
+
+    def _suite(self):
+        from deequ_tpu import Check, CheckLevel
+
+        return [
+            Check(CheckLevel.ERROR, "preempt-diff")
+            .is_complete("k1")
+            .is_non_negative("k1")
+            .is_complete("v1")
+        ]
+
+    def _interactive_suite(self):
+        from deequ_tpu import Check, CheckLevel
+
+        return [
+            Check(CheckLevel.ERROR, "preempt-quick").is_complete("k1")
+        ]
+
+    def _request(self, factory, priority, key):
+        return RunRequest(
+            tenant="acme",
+            checks=(
+                self._suite()
+                if priority == Priority.BATCH
+                else self._interactive_suite()
+            ),
+            dataset_key=key,
+            dataset_factory=factory,
+            priority=priority,
+        )
+
+    def _run_differential(self, factory, journal_root, placer=None):
+        """One uninterrupted reference run, then the same suite
+        preempted mid-scan and resumed; returns both fingerprints and
+        the preemption count observed for the second leg."""
+        from deequ_tpu import config
+
+        with config.configure(
+            batch_size=4096, checkpoint_every_batches=1
+        ):
+            solo_svc = VerificationService(
+                workers=1, isolated=False, preemption=True,
+                journal_dir=str(journal_root / "solo"),
+                placer=placer,
+            ).start()
+            try:
+                solo = solo_svc.submit(
+                    self._request(factory, Priority.BATCH, "diff/solo")
+                )
+                assert solo.wait(timeout=120)
+                assert solo.status == RunState.DONE
+            finally:
+                solo_svc.stop(drain=False, timeout=30)
+
+            before = _count("service.preemptions")
+            svc = VerificationService(
+                workers=1, isolated=False, preemption=True,
+                journal_dir=str(journal_root / "preempted"),
+                placer=placer,
+            ).start()
+            try:
+                batch = svc.submit(
+                    self._request(factory, Priority.BATCH, "diff/batch")
+                )
+                assert _spin_until(
+                    lambda: batch.status == RunState.RUNNING,
+                    timeout_s=60,
+                )
+                quick = svc.submit(
+                    self._request(
+                        factory, Priority.INTERACTIVE, "diff/quick"
+                    )
+                )
+                assert quick.wait(timeout=120)
+                assert batch.wait(timeout=120)
+                assert batch.status == RunState.DONE
+                result = batch.result(timeout=0)
+                assert result.interruption is None
+            finally:
+                svc.stop(drain=False, timeout=30)
+            preemptions = _count("service.preemptions") - before
+            return (
+                _fingerprint(solo.result(timeout=0)),
+                _fingerprint(result),
+                preemptions,
+            )
+
+    def test_resident_preempt_resume_bit_identical(self, tmp_path):
+        solo_print, resumed_print, preemptions = self._run_differential(
+            self._make_dataset, tmp_path
+        )
+        assert preemptions == 1
+        assert _count("service.preempt_resumes") >= 1
+        assert resumed_print == solo_print
+
+    def test_streaming_preempt_resume_bit_identical(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu import config
+        from deequ_tpu.data import Dataset
+
+        rng = np.random.default_rng(29)
+        table = pa.table(
+            {
+                "k1": rng.integers(
+                    0, 1 << 40, self.ROWS, dtype=np.int64
+                ),
+                "v1": rng.normal(0, 1, self.ROWS).astype(np.float32),
+            }
+        )
+        data_dir = tmp_path / "parquet"
+        data_dir.mkdir()
+        shard = self.ROWS // 4
+        for i in range(4):
+            pq.write_table(
+                table.slice(i * shard, None if i == 3 else shard),
+                str(data_dir / f"part{i}.parquet"),
+            )
+
+        def factory():
+            return Dataset.from_parquet(str(data_dir))
+
+        with config.configure(device_cache_bytes=0):
+            solo_print, resumed_print, preemptions = (
+                self._run_differential(factory, tmp_path)
+            )
+        assert preemptions == 1
+        assert resumed_print == solo_print
+
+    def test_mesh_placed_preempt_revokes_lease(self, tmp_path):
+        """The placer-backed variant: the victim holds a device lease,
+        so the preemption path must revoke it (accounted) rather than
+        release it — and the resumed run must still be bit-equal."""
+        from deequ_tpu.service import ElasticPlacer
+
+        lease_revocations = _count("service.lease_revocations")
+        solo_print, resumed_print, preemptions = self._run_differential(
+            self._make_dataset, tmp_path, placer=ElasticPlacer()
+        )
+        assert preemptions == 1
+        assert resumed_print == solo_print
+        assert _count("service.lease_revocations") > lease_revocations
+
+
+# ---------------------------------------------------------------------------
+# spawn-path preemption: the isolated child exits cleanly, no SIGKILL
+# ---------------------------------------------------------------------------
+
+
+def _spawn_dataset():
+    """Module-level (spawn pickles by reference): the child rebuilds
+    the same deterministic table from the seed."""
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(31)
+    rows = 200_000
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "k1": rng.integers(0, 1 << 40, rows, dtype=np.int64),
+                "v1": rng.normal(0, 1, rows).astype(np.float32),
+            }
+        )
+    )
+
+
+class TestIsolatedPreemption:
+    def test_preempt_during_spawn_execution(self, tmp_path):
+        """The victim runs in a spawn child: the preempt token's
+        cancel crosses the control pipe, the child exits through its
+        checkpoint path (clean exit code, partial result in-band), and
+        the requeued run resumes to a complete, uninterrupted result
+        — the child is never terminated or killed. Checks hold
+        lambdas (they never pickle), so the spawn-safe request carries
+        ``required_analyzers`` — the test asserts the run really
+        crossed the process boundary (no inline fallback)."""
+        from deequ_tpu import config
+        from deequ_tpu.analyzers import Completeness, Mean, Size
+
+        before = _count("service.preempt_requeues")
+        fallbacks = _count("service.isolation_inline_fallbacks")
+        with config.configure(
+            batch_size=4096, checkpoint_every_batches=1
+        ):
+            svc = VerificationService(
+                workers=1, isolated=True, preemption=True,
+                journal_dir=str(tmp_path),
+            ).start()
+            try:
+                batch = svc.submit(
+                    RunRequest(
+                        tenant="acme",
+                        checks=(),
+                        required_analyzers=[
+                            Completeness("k1"),
+                            Mean("v1"),
+                        ],
+                        dataset_key="spawn/batch",
+                        dataset_factory=_spawn_dataset,
+                        priority=Priority.BATCH,
+                    )
+                )
+                assert _spin_until(
+                    lambda: batch.status == RunState.RUNNING,
+                    timeout_s=60,
+                )
+                quick = svc.submit(
+                    RunRequest(
+                        tenant="globex",
+                        checks=(),
+                        required_analyzers=[Size()],
+                        dataset_key="spawn/quick",
+                        dataset_factory=_spawn_dataset,
+                        priority=Priority.INTERACTIVE,
+                    )
+                )
+                assert quick.wait(timeout=300)
+                assert quick.status == RunState.DONE
+                assert batch.wait(timeout=300)
+                assert batch.status == RunState.DONE
+                assert batch.result(timeout=0).interruption is None
+            finally:
+                svc.stop(drain=False, timeout=30)
+        assert _count("service.preempt_requeues") == before + 1
+        # both runs really spawned: nothing fell back in-process
+        assert _count(
+            "service.isolation_inline_fallbacks"
+        ) == fallbacks

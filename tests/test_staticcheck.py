@@ -1477,3 +1477,112 @@ class TestMetricDocs:
             if f.rule == "metric-docs"
         ]
         assert found == []
+
+
+# --------------------------------------------------------------------------
+# preempt-discipline: no requeue/revoke without checkpoint evidence
+# --------------------------------------------------------------------------
+
+PREEMPT_REQUEUE_UNGUARDED = """
+class Scheduler:
+    def finish(self, ticket, outcome):
+        self.queue.requeue(ticket)
+"""
+
+PREEMPT_REVOKE_UNGUARDED = """
+class Scheduler:
+    def release(self, lease, group):
+        self.placer.revoke(lease, run_ids=[])
+"""
+
+PREEMPT_CORRECTED = """
+from deequ_tpu.service.preempt import preempt_checkpoint_evidence
+
+class Scheduler:
+    def finish(self, ticket, outcome):
+        evidence = preempt_checkpoint_evidence(ticket, outcome)
+        if evidence is None:
+            return False
+        self.queue.requeue(ticket)
+        return True
+
+    def release(self, lease, group):
+        preempted = [
+            t for t in group
+            if preempt_checkpoint_evidence(t) is not None
+        ]
+        if preempted:
+            self.placer.revoke(lease, run_ids=preempted)
+"""
+
+PREEMPT_NESTED_SCOPE = """
+from deequ_tpu.service.preempt import preempt_checkpoint_evidence
+
+class Scheduler:
+    def finish(self, ticket, outcome):
+        preempt_checkpoint_evidence(ticket, outcome)
+
+        def later():
+            # the nested scope never established its own evidence
+            self.queue.requeue(ticket)
+
+        return later
+"""
+
+PREEMPT_BARE_NAME = """
+def requeue(ticket):
+    return ticket
+
+def finish(ticket):
+    requeue(ticket)
+"""
+
+
+class TestPreemptDiscipline:
+    SCOPED_REL = "deequ_tpu/service/fixture.py"
+
+    def test_catches_unguarded_requeue(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, PREEMPT_REQUEUE_UNGUARDED)
+        found = _rules_found(tmp_path, "preempt-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "requeue"
+        assert "preempt_checkpoint_evidence" in found[0].message
+
+    def test_catches_unguarded_revoke(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, PREEMPT_REVOKE_UNGUARDED)
+        found = _rules_found(tmp_path, "preempt-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "revoke"
+
+    def test_silent_on_corrected_twin(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, PREEMPT_CORRECTED)
+        assert _rules_found(tmp_path, "preempt-discipline") == []
+
+    def test_nested_function_needs_its_own_evidence(self, tmp_path):
+        # the enclosing scope's evidence call does not license a
+        # requeue inside a nested function: deferred execution escapes
+        # the cancel -> evidence -> requeue ordering
+        _write(tmp_path, self.SCOPED_REL, PREEMPT_NESTED_SCOPE)
+        found = _rules_found(tmp_path, "preempt-discipline")
+        assert len(found) == 1
+        assert found[0].symbol == "requeue"
+
+    def test_out_of_scope_module_is_silent(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            PREEMPT_REQUEUE_UNGUARDED,
+        )
+        assert _rules_found(tmp_path, "preempt-discipline") == []
+
+    def test_bare_name_call_is_not_the_queue(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, PREEMPT_BARE_NAME)
+        assert _rules_found(tmp_path, "preempt-discipline") == []
+
+    def test_shipped_tree_is_clean(self):
+        found = [
+            f
+            for f in unwaived(run_analyzers(REPO_ROOT))
+            if f.rule == "preempt-discipline"
+        ]
+        assert found == []

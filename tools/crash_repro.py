@@ -1,0 +1,288 @@
+"""Minimal crash reproducer for the streamed-child crash family.
+
+ROADMAP item 1a: streamed configs occasionally die in spawn children
+with SIGSEGV/SIGABRT at >= ~800k rows.  This tool drives ONE streamed
+parquet config at a time under the isolation harness
+(:class:`deequ_tpu.engine.subproc.IsolatedRunner`, single attempt, no
+breaker) and bisects the three suspect dimensions:
+
+- ``batch_size``     — halved while the crash still reproduces
+- ``xla_cache``      — persistent XLA compilation cache on/off (the
+                       PR 12 ops note flagged a poisoned cache entry
+                       as a suspect: if turning the cache off makes
+                       the crash vanish, the cache is implicated)
+- ``ingest_workers`` — parallel ingest vs the serial bit-identical
+                       path (``ingest_workers=1``)
+- ``rows``           — halved while the crash still reproduces, to
+                       find the smallest dataset that still dies
+
+The output is a single JSON verdict naming the narrowest reproducing
+config, whether the persistent XLA cache is implicated, and the full
+trial log::
+
+    python -m tools.crash_repro --rows 1000000 --out verdict.json
+
+The bisection core (:func:`bisect_crash`) is pure — it takes any
+``probe(config) -> {"crashed": bool, ...}`` callable — so the search
+logic is unit-testable without ever spawning a child.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+MIN_BATCH = 1 << 12
+MIN_ROWS = 50_000
+
+BASE_CONFIG: Dict[str, Any] = {
+    # ROADMAP pins the family at >= ~800k rows; start just above
+    "rows": 1_000_000,
+    # engine default batch (None) is where the crashes were seen; the
+    # bisect needs a concrete number to halve, so start at the
+    # streaming bench's 512k
+    "batch_size": 1 << 19,
+    "ingest_workers": 0,  # 0 = auto (parallel ingest)
+    "xla_cache": True,  # persistent compilation cache enabled
+}
+
+
+# -- pure bisection core ------------------------------------------------
+
+
+def bisect_crash(
+    probe: Callable[[Dict[str, Any]], Dict[str, Any]],
+    base: Optional[Dict[str, Any]] = None,
+    *,
+    min_batch: int = MIN_BATCH,
+    min_rows: int = MIN_ROWS,
+) -> Dict[str, Any]:
+    """Shrink ``base`` one dimension at a time, keeping every step
+    that still reproduces.  Returns the verdict dict.
+
+    ``probe`` runs one config and reports ``{"crashed": bool, ...}``;
+    extra keys (signal name, detail) are carried into the trial log.
+    """
+    base = dict(BASE_CONFIG if base is None else base)
+    trials: List[Dict[str, Any]] = []
+
+    def attempt(cfg: Dict[str, Any], label: str) -> bool:
+        outcome = probe(dict(cfg))
+        trials.append(
+            {"label": label, "config": dict(cfg), "outcome": outcome}
+        )
+        return bool(outcome.get("crashed"))
+
+    verdict: Dict[str, Any] = {
+        "reproduced": False,
+        "baseline": dict(base),
+        "narrowest": None,
+        "xla_cache_implicated": False,
+        "trials": trials,
+    }
+    if not attempt(base, "baseline"):
+        return verdict
+    verdict["reproduced"] = True
+    narrowest = dict(base)
+
+    # 1. persistent XLA cache: flip it off first — if the crash
+    #    vanishes without it, the poisoned-cache suspicion is confirmed
+    #    and every later trial keeps the cache ON to stay in the
+    #    reproducing family
+    if narrowest.get("xla_cache"):
+        candidate = dict(narrowest, xla_cache=False)
+        if attempt(candidate, "xla_cache_off"):
+            narrowest = candidate  # crashes either way: cache innocent
+        else:
+            verdict["xla_cache_implicated"] = True
+
+    # 2. batch size: halve while the crash survives
+    while narrowest["batch_size"] // 2 >= min_batch:
+        candidate = dict(narrowest, batch_size=narrowest["batch_size"] // 2)
+        if not attempt(candidate, "halve_batch"):
+            break
+        narrowest = candidate
+
+    # 3. ingest workers: the serial path is the narrowest claim — if
+    #    it still crashes, parallel ingest is off the hook
+    if narrowest["ingest_workers"] != 1:
+        candidate = dict(narrowest, ingest_workers=1)
+        if attempt(candidate, "serial_ingest"):
+            narrowest = candidate
+
+    # 4. rows: halve while the crash survives
+    while narrowest["rows"] // 2 >= min_rows:
+        candidate = dict(narrowest, rows=narrowest["rows"] // 2)
+        if not attempt(candidate, "halve_rows"):
+            break
+        narrowest = candidate
+
+    verdict["narrowest"] = narrowest
+    return verdict
+
+
+# -- the real probe: one streamed config under the isolation harness ----
+
+
+def _child_scan(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Runs IN THE SPAWN CHILD: one streamed profile pass over the
+    sharded parquet table with the bisected knobs applied."""
+    from deequ_tpu import config
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    overrides: Dict[str, Any] = {
+        # device cache off => every byte re-streams (the crash family
+        # is exclusive to streamed configs)
+        "device_cache_bytes": 0,
+        "batch_size": int(payload["batch_size"]),
+        "ingest_workers": int(payload["ingest_workers"]),
+    }
+    if not payload["xla_cache"]:
+        overrides["compilation_cache_dir"] = ""  # disables the cache
+    with config.configure(**overrides):
+        profiles = ColumnProfiler.profile(
+            Dataset.from_parquet(payload["data_dir"])
+        )
+    return {"columns": len(profiles.profiles)}
+
+
+def _write_shards(data_dir: str, rows: int, shards: int = 4) -> None:
+    """Synthetic multi-file parquet table shaped like the failing
+    workloads: int64 keys, f64 measures, dictionary strings."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(1729)
+    table = pa.table(
+        {
+            "key": pa.array(rng.integers(0, 1 << 31, size=rows)),
+            "qty": pa.array(rng.integers(0, 100, size=rows)),
+            "price": pa.array(rng.random(rows) * 500.0),
+            "status": pa.array(
+                np.array(["ok", "hold", "void"])[
+                    rng.integers(0, 3, size=rows)
+                ]
+            ),
+        }
+    )
+    shard_rows = rows // shards
+    for i in range(shards):
+        length = None if i == shards - 1 else shard_rows
+        pq.write_table(
+            table.slice(i * shard_rows, length),
+            os.path.join(data_dir, f"part{i}.parquet"),
+        )
+
+
+class IsolatedProbe:
+    """Probe one config in a spawn child; a child death (any signal)
+    counts as "reproduced".  Single attempt — no relaunch, no breaker:
+    a reproducer must observe the first crash, not recover from it."""
+
+    def __init__(self, workdir: str, *, timeout_s: float = 600.0):
+        self.workdir = workdir
+        self.timeout_s = timeout_s
+        self._data_dirs: Dict[int, str] = {}
+
+    def _data_dir(self, rows: int) -> str:
+        cached = self._data_dirs.get(rows)
+        if cached is not None:
+            return cached
+        data_dir = os.path.join(self.workdir, f"rows{rows}")
+        os.makedirs(data_dir, exist_ok=True)
+        _write_shards(data_dir, rows)
+        self._data_dirs[rows] = data_dir
+        return data_dir
+
+    def __call__(self, cfg: Dict[str, Any]) -> Dict[str, Any]:
+        from deequ_tpu.engine.subproc import CrashLoopError, IsolatedRunner
+
+        payload = {
+            "data_dir": self._data_dir(int(cfg["rows"])),
+            "batch_size": int(cfg["batch_size"]),
+            "ingest_workers": int(cfg["ingest_workers"]),
+            "xla_cache": bool(cfg["xla_cache"]),
+        }
+        runner = IsolatedRunner(
+            key="crash-repro",
+            max_relaunches=1,  # first crash ends the attempt
+            use_breaker=False,
+            timeout_s=self.timeout_s,
+        )
+        try:
+            result = runner.run(_child_scan, payload)
+        except CrashLoopError as crash:
+            return {
+                "crashed": True,
+                "signal": crash.last_signal,
+                "exitcode": crash.last_exitcode,
+                "detail": str(crash),
+            }
+        except Exception as exc:  # in-band child error: NOT a crash
+            return {"crashed": False, "error": repr(exc)}
+        return {"crashed": False, "result": result}
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="crash_repro",
+        description=(
+            "bisect the streamed-child crash family to its narrowest "
+            "reproducing config (ROADMAP item 1a)"
+        ),
+    )
+    parser.add_argument(
+        "--rows",
+        type=int,
+        default=BASE_CONFIG["rows"],
+        help="baseline row count (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--batch-size",
+        type=int,
+        default=BASE_CONFIG["batch_size"],
+        help="baseline batch size (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--timeout-s",
+        type=float,
+        default=600.0,
+        help="per-trial child deadline (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--out",
+        default="",
+        help="write the JSON verdict here as well as stdout",
+    )
+    args = parser.parse_args(argv)
+
+    base = dict(
+        BASE_CONFIG, rows=int(args.rows), batch_size=int(args.batch_size)
+    )
+    workdir = tempfile.mkdtemp(prefix="deequ_tpu_crash_repro_")
+    try:
+        probe = IsolatedProbe(workdir, timeout_s=args.timeout_s)
+        verdict = bisect_crash(probe, base)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    text = json.dumps(verdict, indent=2, sort_keys=True, default=repr)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    return 0 if verdict["reproduced"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -434,6 +434,42 @@ def render_service(records: List[Dict[str, Any]]) -> str:
             + ")"
         )
 
+    # checkpoint-conserving preemption + autoscaling (docs/SERVICE.md
+    # "Preemption and autoscaling"): did interactive demand displace
+    # batch work, how much scan progress the cursors carried across,
+    # and what the control loop actuated
+    preempted = [
+        e for e in events if e.get("event") == "service_run_preempted"
+    ]
+    if preempted:
+        resumed = sum(
+            1 for e in events
+            if e.get("event") == "service_run_resumed"
+        )
+        conserved = sum(
+            int(e.get("batch_index", 0)) for e in preempted
+            if e.get("checkpointed")
+        )
+        lines.append(
+            f"  preemption: {len(preempted)} preempted,"
+            f" {resumed} resumed"
+            f" (batches conserved={conserved})"
+        )
+    adjustments = [
+        e for e in events if e.get("event") == "autoscale_adjustment"
+    ]
+    if adjustments:
+        by_knob: Dict[str, int] = {}
+        for e in adjustments:
+            knob = str(e.get("knob", "?"))
+            by_knob[knob] = by_knob.get(knob, 0) + 1
+        knobs = ", ".join(
+            f"{k} x{c}" for k, c in sorted(by_knob.items())
+        )
+        lines.append(
+            f"  autoscale: {len(adjustments)} adjustment(s) ({knobs})"
+        )
+
     # drains / rejections worth an operator's attention
     drains = [
         e for e in service_events

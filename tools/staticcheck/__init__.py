@@ -31,6 +31,7 @@ from tools.staticcheck import interrupts as _interrupts  # noqa: F401,E402
 from tools.staticcheck import locks as _locks  # noqa: F401,E402
 from tools.staticcheck import metricdocs as _metricdocs  # noqa: F401,E402
 from tools.staticcheck import plankey as _plankey  # noqa: F401,E402
+from tools.staticcheck import preempt as _preempt  # noqa: F401,E402
 from tools.staticcheck import procs as _procs  # noqa: F401,E402
 from tools.staticcheck import threads as _threads  # noqa: F401,E402
 from tools.staticcheck import tokens as _tokens  # noqa: F401,E402

@@ -1,0 +1,138 @@
+"""Preempt-discipline analyzer: no requeue/revoke without evidence.
+
+The conservation invariant of checkpoint-conserving preemption
+(docs/SERVICE.md "Preemption and autoscaling"): a run may only be
+requeued — and its lease only REVOKED — after the checkpoint-bearing
+cancel evidence for the attempt has been extracted via
+``preempt_checkpoint_evidence`` (service/preempt.py). A call site that
+skips the evidence step can requeue a run that was never preempted
+(duplicating its work) or revoke a lease for a run that completed
+(losing its result).
+
+The rule is structural, matching how the invariant is written in the
+code: inside ``deequ_tpu/service/``, every call to an attribute named
+``requeue`` or ``revoke`` must be LEXICALLY PRECEDED, within the same
+enclosing function, by a call to ``preempt_checkpoint_evidence`` —
+the cancel -> checkpoint-evidence -> revoke/requeue ordering made
+checkable. Flow-insensitive on purpose: the evidence helper caches its
+verdict on the ticket, so any earlier call in the function establishes
+the verdict every later site reads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+SCOPE_PREFIX = "deequ_tpu/service/"
+
+GUARDED_ATTRS = frozenset({"requeue", "revoke"})
+EVIDENCE_NAME = "preempt_checkpoint_evidence"
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """The last path segment of the called name ('requeue' for
+    ``self.queue.requeue(...)``), or None for computed callees."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _function_sites(
+    tree: ast.AST,
+) -> Iterable[Tuple[Optional[ast.AST], List[ast.Call]]]:
+    """(enclosing function, calls directly inside it) pairs; calls in
+    nested functions belong to the NESTED function (each scope must
+    establish its own evidence), module-level calls to None."""
+    functions = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    owner: dict[int, ast.AST] = {}
+    for fn in functions:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                # innermost function wins: walk visits outer functions
+                # first, so a later (nested) owner overwrites
+                owner[id(node)] = fn
+    by_fn: dict[int, List[ast.Call]] = {}
+    module_level: List[ast.Call] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = owner.get(id(node))
+        if fn is None:
+            module_level.append(node)
+        else:
+            by_fn.setdefault(id(fn), []).append(node)
+    for fn in functions:
+        yield fn, by_fn.get(id(fn), [])
+    if module_level:
+        yield None, module_level
+
+
+class PreemptDisciplineAnalyzer(Analyzer):
+    name = "preempt"
+    rules = ("preempt-discipline",)
+    description = (
+        "requeue/revoke call sites in deequ_tpu/service/ not preceded "
+        "by checkpoint-evidence extraction"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if not sf.rel.startswith(SCOPE_PREFIX) or sf.tree is None:
+                continue
+            for fn, calls in _function_sites(sf.tree):
+                evidence_lines = [
+                    c.lineno
+                    for c in calls
+                    if _call_name(c) == EVIDENCE_NAME
+                ]
+                first_evidence = (
+                    min(evidence_lines) if evidence_lines else None
+                )
+                for call in calls:
+                    attr = _call_name(call)
+                    if attr not in GUARDED_ATTRS:
+                        continue
+                    if not isinstance(call.func, ast.Attribute):
+                        continue  # a local helper, not the queue/placer
+                    if (
+                        first_evidence is not None
+                        and first_evidence < call.lineno
+                    ):
+                        continue
+                    where = (
+                        f"function {getattr(fn, 'name', '?')!r}"
+                        if fn is not None
+                        else "module level"
+                    )
+                    yield Finding(
+                        rule="preempt-discipline",
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            f".{attr}() at {where} without a preceding "
+                            f"{EVIDENCE_NAME}() call — requeue/revoke "
+                            "is only licensed by checkpoint-bearing "
+                            "cancel evidence (docs/SERVICE.md "
+                            '"Preemption and autoscaling")'
+                        ),
+                        symbol=attr,
+                    )
+
+
+register(PreemptDisciplineAnalyzer())
