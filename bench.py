@@ -161,6 +161,7 @@ CONFIG_REGISTRY = {
     ),
     "streaming_bundle_100m": lambda a: bench_streaming_bundle_100m(a["rows"]),
     "rowlevel_egress": lambda a: bench_rowlevel_egress(a["rows"]),
+    "egress_resume": lambda a: bench_egress_resume(a["rows"]),
 }
 
 
@@ -2197,6 +2198,144 @@ def bench_rowlevel_egress(num_rows: int = 4_000_000):
             shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_egress_resume(num_rows: int = 800_000):
+    """Exactly-once egress resume (docs/EGRESS.md "Durable egress"):
+    the quarantine suite streamed uninterrupted, then the SAME suite
+    killed at its halfway batch and resumed from the durable span
+    cursor. The exactly-once claims are priced and pinned in one
+    config: the killed+resumed pair must finish within 10% of the
+    uninterrupted wall (the resume's cursor skips every durably
+    flushed span — only the open span is recomputed),
+    ``engine.egress_rows_replayed`` must stay 0, and the published
+    clean/quarantine split must be BYTE-equal to the uninterrupted
+    artifact."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from deequ_tpu import config
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.egress import RowLevelSink
+    from deequ_tpu.engine.resilience import ScanKilled
+    from deequ_tpu.engine.scan import AnalysisEngine
+    from deequ_tpu.io.state_provider import ScanCheckpointer
+    from deequ_tpu.telemetry import get_telemetry
+    from deequ_tpu.testing.faults import FaultInjectingDataset
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    rng = np.random.default_rng(23)
+    amount = rng.gamma(2.0, 40.0, num_rows)
+    amount[rng.random(num_rows) < 0.01] *= -1.0
+    user = rng.integers(0, max(1, num_rows // 50), num_rows)
+    domain = np.where(rng.random(num_rows) < 0.05, "bad addr", "ex.com")
+    email = np.char.add(
+        np.char.add("u", user.astype("U12")), np.char.add("@", domain)
+    ).astype(object)
+    email[rng.random(num_rows) < 0.02] = None
+    data = Dataset.from_arrow(
+        pa.table(
+            {
+                "event_id": pa.array(np.arange(num_rows, dtype=np.int64)),
+                "amount": pa.array(amount),
+                "email": pa.array(email, type=pa.string()),
+            }
+        )
+    )
+    checks = [
+        Check(CheckLevel.ERROR, "hygiene")
+        .is_complete("email")
+        .has_pattern("email", r"@ex\.com$")
+        .satisfies("amount >= 0", "amount_non_negative")
+    ]
+    batch_size = max(4096, num_rows // 64)
+    nbatches = (num_rows + batch_size - 1) // batch_size
+    kill_at = nbatches // 2
+    tm = get_telemetry()
+    root = tempfile.mkdtemp(prefix="deequ_tpu_bench_egresume_")
+
+    def run(arm, ds):
+        sink = RowLevelSink(os.path.join(root, arm, "out"))
+        engine = AnalysisEngine(
+            checkpointer=ScanCheckpointer(os.path.join(root, arm, "ckpt"))
+        )
+        return VerificationSuite.do_verification_run(
+            ds, checks, engine=engine, row_level_sink=sink
+        )
+
+    def split_bytes(arm):
+        out = {}
+        for split in ("clean", "quarantine"):
+            path = os.path.join(
+                root, arm, "out", split, "part-00000.parquet"
+            )
+            with open(path, "rb") as fh:
+                out[split] = fh.read()
+        return out
+
+    try:
+        with config.configure(
+            device_cache_bytes=0,
+            batch_size=batch_size,
+            checkpoint_every_batches=4,
+        ):
+            run("warm", data)  # priced arms below are steady-state
+            wall_solo, _, _, solo_result = _timed(lambda: run("solo", data))
+
+            killed_ds = FaultInjectingDataset(data, kill_at_batch=kill_at)
+            replayed0 = tm.counter("engine.egress_rows_replayed").value
+            resumes0 = tm.counter("engine.resumes").value
+
+            def killed_then_resumed():
+                try:
+                    run("killed", killed_ds)
+                    raise RuntimeError("injected kill never fired")
+                except ScanKilled:
+                    pass
+                # same artifact dir + checkpoint path: the relaunch
+                # shape, minus the process spawn (priced elsewhere)
+                return run("killed", killed_ds)
+
+            wall_killed, _, _, resumed_result = _timed(killed_then_resumed)
+        rows_replayed = int(
+            tm.counter("engine.egress_rows_replayed").value - replayed0
+        )
+        resumes = int(tm.counter("engine.resumes").value - resumes0)
+        solo_report = solo_result.row_level_egress
+        report = resumed_result.row_level_egress
+        byte_equal = split_bytes("solo") == split_bytes("killed")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    added = (
+        (wall_killed - wall_solo) / wall_solo if wall_solo > 0 else 0.0
+    )
+    return {
+        "rows": num_rows,
+        "batches": nbatches,
+        "kill_at_batch": kill_at,
+        "wall_uninterrupted_s": round(wall_solo, 3),
+        "wall_killed_plus_resume_s": round(wall_killed, 3),
+        "added_wall_pct": round(added * 100.0, 2),
+        # 10% relative plus a small absolute floor (same rationale as
+        # service_preemption: sub-second walls flip on scheduler noise)
+        "resume_within_10pct": bool(
+            wall_killed <= wall_solo * 1.10 + 0.25
+        ),
+        "resumes": resumes,
+        "rows_replayed": rows_replayed,
+        "egress_status": report.status,
+        "rows_clean": report.rows_clean,
+        "rows_quarantined": report.rows_quarantined,
+        "counters_conserved": bool(
+            report.rows_clean == solo_report.rows_clean
+            and report.rows_quarantined == solo_report.rows_quarantined
+            and report.rows_clean + report.rows_quarantined == num_rows
+        ),
+        "split_byte_equal": bool(byte_equal),
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -2480,6 +2619,7 @@ def main(argv=None):
             ),
             ("streaming_bundle_100m", {"rows": 100_000_000}, True, 330),
             ("rowlevel_egress", {"rows": 4_000_000}, True, 200),
+            ("egress_resume", {"rows": 800_000}, True, 150),
         ]
     )
 

@@ -167,9 +167,17 @@ class RowSinkPlan:
             lambda: getattr(engine, "active_degradation", None)
             or engine.last_degradation
         )
+        # arm the engine's durable-egress hooks for THIS scan only:
+        # the checkpoint writer flushes the open span before saving the
+        # cursor, and the resume path reconciles the writer with the
+        # checkpoint before restarting (engine/scan.py)
+        engine.active_egress = self.writer
         self._geometry_bound = True
 
     def note_scan_complete(self, engine) -> None:
+        # later scans in this run (deferred-family fallbacks) must not
+        # touch the sink's durable state
+        engine.active_egress = None
         self._scan_record = (
             getattr(engine, "active_degradation", None)
             or engine.last_degradation
@@ -409,12 +417,6 @@ def plan_row_sink(
     """Build the sink's scan rider for one run, or None (and a
     ``no_row_level_constraints`` report) when nothing in the suite is
     row-level capable."""
-    if getattr(engine, "checkpointer", None) is not None:
-        raise ValueError(
-            "row_level_sink does not compose with checkpoint/resume: a "
-            "resumed scan would re-fold spans the writer already wrote "
-            "(docs/EGRESS.md 'Limits')"
-        )
     (
         planes,
         _where_strings,
@@ -474,6 +476,10 @@ def finalize_row_sink(plan: RowSinkPlan, data, engine) -> EgressReport:
     tm = get_telemetry()
     sink = plan.sink
     writer = plan.writer
+    # defensive: the failed-scan path can reach here without
+    # note_scan_complete ever running — later scans (the deferred
+    # oracle, other runs on this engine) must not see a stale hook
+    engine.active_egress = None
     if plan.scan_failed:
         writer.abort()
         report = EgressReport(

@@ -38,7 +38,9 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +49,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from deequ_tpu.engine.wire import narrowest_int_dtype
+from deequ_tpu.io.storage import durable_replace, storage_for
 from deequ_tpu.telemetry import get_telemetry
 
 #: ``__failed_constraints__`` marker for rows the scan never evaluated
@@ -61,6 +64,38 @@ _PROV_ERR_MSG = "__error_message__"
 _PROV_ATTEMPTS = "__retry_attempts__"
 _PROV_TENANT = "__tenant__"
 _PROV_RUN = "__run_id__"
+
+#: segment-internal routing column: 0 = clean, 1 = quarantine. Exists
+#: only inside ``spans/seg-*.parquet`` — compaction strips it before a
+#: row group reaches the public split.
+_SPLIT_COL = "__egress_split__"
+
+#: columns only the quarantine split carries (null on clean rows while
+#: they ride a span segment; dropped again at compaction)
+_Q_ONLY = (
+    _PROV_FAILED,
+    _PROV_ERR_CLASS,
+    _PROV_ERR_MSG,
+    _PROV_ATTEMPTS,
+    _PROV_TENANT,
+    _PROV_RUN,
+)
+
+_Q_ONLY_TYPES = {
+    _PROV_FAILED: pa.string(),
+    _PROV_ERR_CLASS: pa.string(),
+    _PROV_ERR_MSG: pa.string(),
+    _PROV_ATTEMPTS: pa.int32(),
+    _PROV_TENANT: pa.string(),
+    _PROV_RUN: pa.string(),
+}
+
+#: CRC-stamped segment footer: a span segment is an ordinary parquet
+#: payload followed by ``<magic, payload_len, crc32>`` — readers strip
+#: and verify the footer, so a torn tail (crash mid-publish) is
+#: DETECTED, never half-read as data
+_SEG_MAGIC = b"DQTPUSG1"
+_SEG_FOOTER = struct.Struct("<8sqI")
 
 
 @dataclass
@@ -244,8 +279,20 @@ class QuarantineWriter:
         self._spool = None
         self._spool_path = os.path.join(sink.out_dir, "_scan_bits.spool")
         os.makedirs(sink.out_dir, exist_ok=True)
-        if self.spool_mode:
-            self._spool = open(self._spool_path, "wb")
+        # span-segment state (docs/EGRESS.md "Durable egress"): every
+        # span lands in an OPEN streamed segment under spans/, rotated
+        # to a CRC-stamped seg-{seq}.parquet at each durable flush and
+        # compacted into the public split at finish. The spool is
+        # opened LAZILY in append mode — eager "wb" here would truncate
+        # the very bytes a resume is about to trust.
+        self._seg_dir = os.path.join(sink.out_dir, "spans")
+        self._seg_tmp = os.path.join(self._seg_dir, ".seg-open.tmp")
+        self._seg_seq = 0
+        self._seg_writer: Optional[pq.ParquetWriter] = None
+        self._seg_schema: Optional[pa.Schema] = None
+        self._open_span_rows = 0
+        self._span_row_bound: Optional[int] = None
+        self._rows_replayed = 0
 
     # -- wiring ---------------------------------------------------------
 
@@ -261,6 +308,15 @@ class QuarantineWriter:
             1, -(-max(self.num_rows, 1) // max(self._batch_size, 1))
         )
         self._seq_dtype = narrowest_int_dtype(0, n_units - 1)
+        # bound the OPEN span segment to one checkpoint interval's
+        # worth of rows — past that it rotates to disk (with an
+        # egress_span_overflow event) instead of growing silently
+        from deequ_tpu import config
+
+        every = int(config.options().checkpoint_every_batches)
+        self._span_row_bound = (
+            every * self._batch_size if every > 0 else None
+        )
 
     def set_degradation_probe(self, probe) -> None:
         """Direct mode: a callable returning the ACTIVE scan's live
@@ -284,9 +340,10 @@ class QuarantineWriter:
                 f"{self._plane_shape}"
             )
         if self.spool_mode:
-            self._spool.write(struct.pack("<q", valid))
-            self._spool.write(bits.tobytes())
-            self._spool.flush()
+            spool = self._ensure_spool()
+            spool.write(struct.pack("<q", valid))
+            spool.write(bits.tobytes())
+            spool.flush()
             return
         if self._probe is not None:
             self._refresh_failures(self._probe())
@@ -404,6 +461,7 @@ class QuarantineWriter:
         self.rows_clean += n_clean
         self.rows_quarantined += valid - n_clean
         self.cursor += valid
+        self._note_open_span()
 
     def _failed_labels(
         self,
@@ -447,6 +505,7 @@ class QuarantineWriter:
         )
         self.rows_quarantined += span.length
         self.cursor += span.length
+        self._note_open_span()
 
     def _write_split(
         self,
@@ -504,8 +563,10 @@ class QuarantineWriter:
             arrays.append(pa.array([self.sink.run_id] * n, pa.string()))
             names.append(_PROV_RUN)
         table = pa.Table.from_arrays(arrays, names=names)
-        writer = self._ensure_writer(which, table.schema)
-        writer.write_table(table)  # one row group per span: the flush
+        # one row group per span-split, into the OPEN segment — the
+        # per-batch flush the wire-discipline rule requires; the public
+        # split files materialize at compaction (finish)
+        self._segment_append(which, table)
         nbytes = table.nbytes
         self.bytes_encoded += nbytes
         self.bytes_raw += nbytes + raw_extra
@@ -525,6 +586,336 @@ class QuarantineWriter:
             self._schemas[which] = schema
         return writer
 
+    # -- durable span segments (docs/EGRESS.md "Durable egress") --------
+
+    def _ensure_spool(self):
+        """Open the bit-plane spool lazily, in APPEND mode — after a
+        resume the file already holds every fsynced record up to the
+        cursor's ``plane_spool_offset`` and must not be truncated."""
+        if self._spool is None:
+            self._spool = open(self._spool_path, "ab")
+        return self._spool
+
+    def _segment_schema(self, first: pa.Schema) -> pa.Schema:
+        """The segment superset schema: row columns + outcome columns +
+        provenance, the quarantine-only columns (null on clean rows),
+        and the routing tag. Identical whichever split seeds it, so
+        every segment of a run — and of its resumed reincarnations —
+        shares one schema."""
+        fields = list(first)
+        names = set(first.names)
+        for name in _Q_ONLY:
+            if name not in names:
+                fields.append(pa.field(name, _Q_ONLY_TYPES[name]))
+        fields.append(pa.field(_SPLIT_COL, pa.int8()))
+        return pa.schema(fields)
+
+    def _segment_append(self, which: str, table: pa.Table) -> None:
+        if self._seg_writer is None:
+            if self._seg_schema is None:
+                self._seg_schema = self._segment_schema(table.schema)
+            os.makedirs(self._seg_dir, exist_ok=True)
+            self._seg_writer = pq.ParquetWriter(
+                self._seg_tmp, self._seg_schema
+            )
+        n = table.num_rows
+        split_val = 1 if which == "quarantine" else 0
+        arrays = []
+        for fld in self._seg_schema:
+            if fld.name == _SPLIT_COL:
+                arrays.append(
+                    pa.array(np.full(n, split_val, dtype=np.int8))
+                )
+            elif fld.name in table.schema.names:
+                arrays.append(table.column(fld.name))
+            else:
+                arrays.append(pa.nulls(n, fld.type))
+        self._seg_writer.write_table(
+            pa.Table.from_arrays(arrays, schema=self._seg_schema)
+        )
+        self._open_span_rows += n
+
+    def _note_open_span(self) -> None:
+        """Bound the open (not yet durably flushed) segment: past one
+        checkpoint interval's worth of rows it is rotated to disk with
+        an ``egress_span_overflow`` event instead of growing silently.
+        A healthy checkpointed run never trips this — the checkpoint
+        flush rotates first; rows in an overflow segment past the last
+        cursor are simply truncated-and-rescanned on resume."""
+        bound = self._span_row_bound
+        if bound is None or self._open_span_rows <= bound:
+            return
+        get_telemetry().event(
+            "egress_span_overflow",
+            open_rows=self._open_span_rows,
+            bound=bound,
+            span_seq=self._seg_seq,
+        )
+        self._finalize_open_segment()
+        # an overflow rotation is durable progress between checkpoints
+        # — stream it to the isolation parent like a checkpoint flush
+        from deequ_tpu.engine.subproc import notify_egress_progress
+
+        notify_egress_progress(
+            {
+                "span_seq": self._seg_seq - 1,
+                "rows_clean": self.rows_clean,
+                "rows_quarantined": self.rows_quarantined,
+                "spool_offset": 0,
+            }
+        )
+
+    def _finalize_open_segment(self) -> bool:
+        """Close the open segment, stamp its CRC footer, and DURABLY
+        publish it as ``spans/seg-{seq:010d}.parquet`` (fsync + atomic
+        rename + directory fsync). Returns False when nothing was
+        written since the last rotation. This is the durable-flush
+        evidence that must lexically precede every
+        :class:`EgressCursor` construction (the ``egress-durability``
+        staticcheck rule)."""
+        if self._seg_writer is None:
+            return False
+        self._seg_writer.close()
+        self._seg_writer = None
+        with open(self._seg_tmp, "rb") as fh:
+            payload = fh.read()
+        footer = _SEG_FOOTER.pack(
+            _SEG_MAGIC, len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+        )
+        with open(self._seg_tmp, "ab") as fh:
+            fh.write(footer)
+        final = os.path.join(
+            self._seg_dir, f"seg-{self._seg_seq:010d}.parquet"
+        )
+        durable_replace(self._seg_tmp, final)
+        self._seg_seq += 1
+        self._open_span_rows = 0
+        get_telemetry().counter("engine.egress_spans_flushed").inc()
+        return True
+
+    def flush_durable(self):
+        """Make every row consumed so far durable and return the
+        :class:`~deequ_tpu.io.state_provider.EgressCursor` naming the
+        durable state. Called by the engine's checkpoint writer AFTER
+        the pending host folds are drained and BEFORE the ScanCursor is
+        saved — the write-ahead ordering (flush THEN cursor) that makes
+        resume replay-nothing/drop-nothing."""
+        from deequ_tpu.io.state_provider import EgressCursor
+
+        spool_offset = 0
+        if self.spool_mode and self._spool is not None:
+            self._spool.flush()
+            os.fsync(self._spool.fileno())
+            spool_offset = self._spool.tell()
+        self._finalize_open_segment()
+        cursor = EgressCursor(
+            last_durably_flushed_span_seq=self._seg_seq - 1,
+            rows_emitted_clean=self.rows_clean,
+            rows_emitted_quarantined=self.rows_quarantined,
+            plane_spool_offset=spool_offset,
+            bytes_raw=self.bytes_raw,
+            bytes_encoded=self.bytes_encoded,
+        )
+        # a spawned child streams the durable cursor to its parent so
+        # egress advancement between checkpoints resets the crash-loop
+        # budget (engine/subproc.py progress frames)
+        from deequ_tpu.engine.subproc import notify_egress_progress
+
+        notify_egress_progress(
+            {
+                "span_seq": cursor.last_durably_flushed_span_seq,
+                "rows_clean": self.rows_clean,
+                "rows_quarantined": self.rows_quarantined,
+                "spool_offset": spool_offset,
+            }
+        )
+        return cursor
+
+    def align_resume(self, payload):
+        """Reconcile the writer with a (possibly absent) scan
+        checkpoint BEFORE the scan restarts. With a trustworthy egress
+        cursor in the checkpoint the durable state is restored — torn
+        tail truncated past the cursor's span seq, span reader
+        fast-forwarded, zero rows replayed — and the payload is
+        returned for the scan to resume from. Anything else (no
+        checkpoint, a cursor-less checkpoint, missing or corrupt
+        segments) degrades to a FRESH artifact: stale outputs are
+        wiped and None is returned so the scan restarts at row zero."""
+        cursor = payload["cursor"] if payload is not None else None
+        eg = getattr(cursor, "egress", None)
+        if eg is None or not self._resume_from(
+            eg, payload.get("degradation")
+        ):
+            self.start_fresh()
+            return None
+        tm = get_telemetry()
+        # pinned 0 by construction: the cursor was written only after
+        # its span segment fsynced, so nothing needs re-emission
+        tm.counter("engine.egress_rows_replayed").inc(
+            self._rows_replayed
+        )
+        tm.event(
+            "egress_resumed",
+            span_seq=int(eg.last_durably_flushed_span_seq),
+            rows_clean=self.rows_clean,
+            rows_quarantined=self.rows_quarantined,
+            rows_replayed=self._rows_replayed,
+        )
+        return payload
+
+    def _resume_from(self, eg, record) -> bool:
+        seq = int(eg.last_durably_flushed_span_seq)
+        # drop the torn open segment and any segments PAST the cursor
+        # (overflow rotations after the last checkpoint): their rows
+        # were never cursored, so the rescan re-emits them exactly once
+        if os.path.exists(self._seg_tmp):
+            os.remove(self._seg_tmp)
+        have = self._list_segments()
+        if any(s not in have for s in range(seq + 1)):
+            return False
+        if seq >= 0 and not self._segment_intact(have[seq]):
+            return False
+        for s, path in have.items():
+            if s > seq:
+                os.remove(path)
+        offset = int(eg.plane_spool_offset)
+        if self.spool_mode:
+            if not os.path.exists(self._spool_path):
+                if offset:
+                    return False
+            elif os.path.getsize(self._spool_path) < offset:
+                return False
+            else:
+                with open(self._spool_path, "rb+") as fh:
+                    fh.truncate(offset)
+        self.rows_clean = int(eg.rows_emitted_clean)
+        self.rows_quarantined = int(eg.rows_emitted_quarantined)
+        self.cursor = self.rows_clean + self.rows_quarantined
+        self.bytes_raw = int(eg.bytes_raw)
+        self.bytes_encoded = int(eg.bytes_encoded)
+        self._seg_seq = seq + 1
+        self._rows_replayed = 0
+        # fast-forward the sequential span reader past the rows already
+        # durably written — taken and discarded, never re-emitted
+        skip = self.cursor
+        reader = self._ensure_reader()
+        while skip > 0:
+            step = min(skip, 1 << 16)
+            reader.take(step)
+            skip -= step
+        # failure spans already emitted (whole, before the cursor) must
+        # not re-enter the pending queue from the restored record
+        self._refresh_failures(record)
+        self._pending = [
+            s for s in self._pending if s.start >= self.cursor
+        ]
+        return True
+
+    def start_fresh(self) -> None:
+        """Wipe every artifact a previous attempt may have left under
+        ``out_dir`` — segments, split outputs, spool, manifest — so a
+        non-resumable attempt rebuilds from row zero, never on top of
+        stale spans."""
+        if self._seg_writer is not None:
+            try:
+                self._seg_writer.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            self._seg_writer = None
+        for path in (
+            self._seg_tmp,
+            self._spool_path,
+            os.path.join(self.sink.out_dir, "manifest.json"),
+        ):
+            if os.path.exists(path):
+                os.remove(path)
+        for sub in ("spans", "clean", "quarantine"):
+            shutil.rmtree(
+                os.path.join(self.sink.out_dir, sub), ignore_errors=True
+            )
+        self._seg_seq = 0
+        self._open_span_rows = 0
+        self._rows_replayed = 0
+
+    def _list_segments(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        if not os.path.isdir(self._seg_dir):
+            return out
+        for name in os.listdir(self._seg_dir):
+            if name.startswith("seg-") and name.endswith(".parquet"):
+                try:
+                    out[int(name[4:-8])] = os.path.join(
+                        self._seg_dir, name
+                    )
+                except ValueError:
+                    continue
+        return out
+
+    def _read_segment_payload(self, path: str) -> Optional[bytes]:
+        """The parquet payload of a CRC-stamped segment, or None when
+        the footer is missing, torn, or fails its checksum."""
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        if len(blob) < _SEG_FOOTER.size:
+            return None
+        magic, length, crc = _SEG_FOOTER.unpack(
+            blob[-_SEG_FOOTER.size :]
+        )
+        if magic != _SEG_MAGIC or length != len(blob) - _SEG_FOOTER.size:
+            return None
+        payload = blob[:length]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return None
+        return payload
+
+    def _segment_intact(self, path: str) -> bool:
+        try:
+            return self._read_segment_payload(path) is not None
+        except OSError:
+            return False
+
+    def _compact_segments(self) -> None:
+        """Collapse the span segments into the public ``clean/`` +
+        ``quarantine/`` split — row group by row group, in span order,
+        each group routed whole by its ``__egress_split__`` tag — so
+        the compacted layout (one row group per span-split) is
+        byte-identical whether or not the run was ever interrupted."""
+        tm = get_telemetry()
+        have = self._list_segments()
+        for s in range(len(have)):
+            path = have.get(s)
+            if path is None:
+                raise RuntimeError(
+                    f"egress segment seq {s} missing at compaction — "
+                    "the span sequence must be gapless"
+                )
+            payload = self._read_segment_payload(path)
+            if payload is None:
+                raise RuntimeError(
+                    f"egress segment {path} failed its CRC check"
+                )
+            pf = pq.ParquetFile(pa.BufferReader(payload))
+            for g in range(pf.num_row_groups):
+                group = pf.read_row_group(g)
+                if group.num_rows == 0:
+                    continue
+                is_q = bool(group.column(_SPLIT_COL)[0].as_py())
+                which = "quarantine" if is_q else "clean"
+                drop = {_SPLIT_COL}
+                if which == "clean":
+                    drop.update(_Q_ONLY)
+                routed = group.select(
+                    [
+                        nm
+                        for nm in group.schema.names
+                        if nm not in drop
+                    ]
+                )
+                self._ensure_writer(which, routed.schema).write_table(
+                    routed
+                )
+            tm.counter("engine.egress_segments_compacted").inc()
+
     # -- finalize --------------------------------------------------------
 
     def replay_spool(
@@ -535,8 +926,9 @@ class QuarantineWriter:
         """Spool mode phase 2: merge the scanned bit planes with the
         finalize-phase (deferred) outcomes and write the split, span by
         span — bounded by one span, exactly like the direct path."""
-        self._spool.close()
-        self._spool = None
+        if self._spool is not None:
+            self._spool.close()
+            self._spool = None
         self._refresh_failures(record)
         n_planes, b8 = self._plane_shape
         rec_bytes = n_planes * b8
@@ -572,11 +964,26 @@ class QuarantineWriter:
                 f"egress wrote {self.cursor} of {self.num_rows} source "
                 "rows without an interruption — span accounting bug"
             )
+        if interrupted:
+            # leave the artifact in resumable span form: publish the
+            # open segment (rows past the last durable cursor are
+            # truncated-and-rescanned on resume), keep the spool and
+            # segments, write NO split files — only a completing
+            # attempt compacts, so row counters are accounted exactly
+            # once across however many attempts the run took
+            self._finalize_open_segment()
+            if self._spool is not None:
+                self._spool.flush()
+                self._spool.close()
+                self._spool = None
+            return self.rows_clean, self.rows_quarantined
+        self._finalize_open_segment()
+        self._compact_segments()
         for which in ("clean", "quarantine"):
-            if which not in self._writers and self._row_schema is not None:
-                self._ensure_writer(
-                    which, self._empty_schema_for(which)
-                )
+            if which not in self._writers:
+                schema = self._split_schema_for(which)
+                if schema is not None:
+                    self._ensure_writer(which, schema)
         for writer in self._writers.values():
             writer.close()
         self._writers.clear()
@@ -585,6 +992,7 @@ class QuarantineWriter:
             self._spool = None
         if os.path.exists(self._spool_path):
             os.remove(self._spool_path)
+        shutil.rmtree(self._seg_dir, ignore_errors=True)
         tm = get_telemetry()
         tm.counter("engine.rows_clean").inc(self.rows_clean)
         tm.counter("engine.rows_quarantined").inc(self.rows_quarantined)
@@ -601,6 +1009,12 @@ class QuarantineWriter:
         """Scan failed outright: close everything without the
         alignment check; whatever was written stays on disk for
         inspection, the report says 'aborted'."""
+        if self._seg_writer is not None:
+            try:
+                self._seg_writer.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            self._seg_writer = None
         for writer in self._writers.values():
             try:
                 writer.close()
@@ -615,6 +1029,32 @@ class QuarantineWriter:
             self._spool = None
         if os.path.exists(self._spool_path):
             os.remove(self._spool_path)
+
+    def _split_schema_for(self, which: str) -> Optional[pa.Schema]:
+        """Schema for an empty split file. Normally derived from the
+        run's row schema; a resumed run that emitted nothing after its
+        resume never learned one, so the schema is derived from the
+        OTHER split's compacted schema instead (None when neither
+        source exists — no rows at all, no files)."""
+        if self._row_schema is not None:
+            return self._empty_schema_for(which)
+        other = self._schemas.get(
+            "quarantine" if which == "clean" else "clean"
+        )
+        if other is None:
+            return None
+        if which == "clean":
+            return pa.schema(
+                [f for f in other if f.name not in _Q_ONLY]
+            )
+        return pa.schema(
+            list(other)
+            + [
+                pa.field(nm, _Q_ONLY_TYPES[nm])
+                for nm in _Q_ONLY
+                if nm not in other.names
+            ]
+        )
 
     def _empty_schema_for(self, which: str) -> pa.Schema:
         fields = list(self._row_schema)
@@ -653,6 +1093,11 @@ class QuarantineWriter:
             "quarantine": self._paths.get("quarantine", ""),
             **extra,
         }
-        with open(path, "w") as fh:
-            json.dump(payload, fh, indent=2, default=str)
+        blob = json.dumps(payload, indent=2, default=str).encode()
+        # durable + atomic (temp + fsync + rename): a crash during
+        # finalize must never leave a torn manifest for a
+        # status="interrupted" reader to misparse
+        storage_for(self.sink.out_dir).write_bytes(
+            "manifest.json", blob, durable=True
+        )
         return path

@@ -266,10 +266,46 @@ def checkpoint_progress_probe(path: str) -> Callable[[], Tuple]:
             cursor = payload.get("cursor") if isinstance(payload, dict) else None
             batch_index = getattr(cursor, "batch_index", None)
             if batch_index is not None:
-                out.append((key, int(batch_index)))
+                # an egress cursor advancing (new durable span segment,
+                # spool bytes) is forward progress even within one
+                # batch_index — include it in the probe value
+                eg = getattr(cursor, "egress", None)
+                if eg is not None:
+                    out.append(
+                        (
+                            key,
+                            int(batch_index),
+                            int(
+                                getattr(
+                                    eg,
+                                    "last_durably_flushed_span_seq",
+                                    -1,
+                                )
+                            ),
+                            int(getattr(eg, "plane_spool_offset", 0)),
+                        )
+                    )
+                else:
+                    out.append((key, int(batch_index)))
         return tuple(out)
 
     return probe
+
+
+# module-global hook: QuarantineWriter.flush_durable calls this after
+# every durable rotation; in a spawned child it streams an
+# ``("egress", record)`` frame to the parent (installed by
+# ``_child_main``), everywhere else it is a no-op
+_egress_notify: Optional[Callable[[Dict[str, Any]], None]] = None
+
+
+def notify_egress_progress(record: Dict[str, Any]) -> None:
+    """Report a durable egress flush to whoever is listening (the
+    isolation parent, via the child's pipe). Best-effort: no listener,
+    no cost; a torn pipe never fails the flush."""
+    hook = _egress_notify
+    if hook is not None:
+        hook(record)
 
 
 # --------------------------------------------------------------------------
@@ -382,6 +418,19 @@ def _child_main(
                 pass
 
         tm.add_span_sink(_stream_span)
+
+    # durable-egress progress frames are NOT gated on tracing: the
+    # parent's crash-loop accounting needs them whenever a sink run is
+    # isolated, traced or not (notify_egress_progress)
+    def _stream_egress(record: Dict[str, Any]) -> None:
+        try:
+            with send_lock:
+                conn.send(("egress", record))
+        except Exception:  # noqa: BLE001 — best-effort, like spans
+            pass
+
+    global _egress_notify
+    _egress_notify = _stream_egress
     try:
         with tm.trace_scope(ctx):
             with tm.run("isolated_child") as cap:
@@ -496,6 +545,10 @@ class IsolatedRunner:
         # never escalates a cancel to terminate()/kill() (that is the
         # deadline path's job)
         self.cancel_token = cancel_token
+        # last ("egress", record) frame streamed by any child: durable
+        # egress advancement between scan checkpoints also counts as
+        # forward progress for the crash-loop budget (run())
+        self._last_egress_frame: Optional[Dict[str, Any]] = None
         self._ctx = multiprocessing.get_context("spawn")
 
     # -- single launch ---------------------------------------------------
@@ -611,6 +664,14 @@ class IsolatedRunner:
                         if isinstance(msg[1], dict):
                             spans.append(msg[1])
                         continue
+                    if (
+                        isinstance(msg, tuple)
+                        and len(msg) == 2
+                        and msg[0] == "egress"
+                    ):
+                        if isinstance(msg[1], dict):
+                            self._last_egress_frame = msg[1]
+                        continue
                     message = msg
                     break
             except (EOFError, OSError):
@@ -701,6 +762,7 @@ class IsolatedRunner:
         last_progress = (
             self.progress_probe() if self.progress_probe is not None else None
         )
+        last_egress = self._last_egress_frame
         crashes_here = 0  # crashes since the last observed progress
         launches = 0
         last_crash: Optional[ProcessCrashed] = None
@@ -716,6 +778,13 @@ class IsolatedRunner:
                     if progress != last_progress:
                         last_progress = progress
                         crashes_here = 1  # this crash, at the new position
+                # a durable egress flush streamed by the child is
+                # progress too (span segments advance between scan
+                # checkpoints) — a sink run inching forward is never a
+                # crash loop
+                if self._last_egress_frame != last_egress:
+                    last_egress = self._last_egress_frame
+                    crashes_here = 1
                 if crashes_here >= self.max_relaunches:
                     if self.breaker is not None:
                         self.breaker.record_crash_loop(self.key)

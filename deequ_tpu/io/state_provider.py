@@ -186,17 +186,45 @@ def _json_safe(value):
 
 
 @dataclass(frozen=True)
+class EgressCursor:
+    """Durable high-water mark of a run's row-level egress at one
+    checkpoint (docs/EGRESS.md "Durable egress"). Constructed ONLY
+    after the span segment's fsync returned — the write-ahead ordering
+    (flush THEN cursor) the ``egress-durability`` staticcheck rule
+    makes structural — so a resume that trusts it replays zero rows
+    and drops zero rows.
+
+    ``last_durably_flushed_span_seq`` is the sequence number of the
+    newest ``spans/seg-*.parquet`` segment on durable storage (-1 when
+    none, e.g. the spool-mode scan phase); ``plane_spool_offset`` is
+    the fsynced byte length of ``_scan_bits.spool`` (0 outside spool
+    mode). The row/byte counters restore the writer's accounting so a
+    resumed run's report is bit-identical to an uninterrupted one."""
+
+    last_durably_flushed_span_seq: int
+    rows_emitted_clean: int
+    rows_emitted_quarantined: int
+    plane_spool_offset: int
+    bytes_raw: int = 0
+    bytes_encoded: int = 0
+
+
+@dataclass(frozen=True)
 class ScanCursor:
     """Position of a checkpoint inside a scan: ``batch_index`` batches
     are already folded into the saved states (resume starts there);
     ``row_offset`` is the source-row high-water mark; the fingerprint
     pins the SOURCE (a changed source invalidates the checkpoint — the
-    monoid fold would silently mix two datasets otherwise)."""
+    monoid fold would silently mix two datasets otherwise). A run with
+    a row-level sink additionally carries the sink's
+    :class:`EgressCursor` — written only AFTER the span segment it
+    names was durably flushed, so resume never re-emits a row."""
 
     batch_index: int
     row_offset: int
     source_fingerprint: str
     batch_size: int
+    egress: Optional[Any] = None
 
 
 class ScanCheckpointer:

@@ -147,6 +147,29 @@ class LocalStorage(Storage):
             pass
 
 
+def durable_replace(tmp_path: str, final_path: str) -> None:
+    """Atomically and DURABLY publish ``tmp_path`` (a fully written
+    file) as ``final_path``: fsync the data, rename, fsync the parent
+    directory — the same discipline as ``LocalStorage.write_bytes(
+    durable=True)``, for callers that stream a file to disk (the
+    egress span segments) instead of holding bytes in memory. After
+    return the file survives power loss under its final name; some
+    filesystems refuse directory fsync, which is treated as "as
+    durable as this FS gets", not an error."""
+    with open(tmp_path, "rb+") as fh:
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp_path, final_path)
+    try:
+        dir_fd = os.open(os.path.dirname(final_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:
+        pass
+
+
 class MemoryStorage(Storage):
     """In-process storage (``mem://name``): one shared namespace per
     URI, thread-safe — the remote-backend stand-in for tests."""

@@ -149,9 +149,21 @@ class PreemptionController:
     which costs nothing.
     """
 
-    def __init__(self, clock: Any, max_preemptions_per_run: int = 3):
+    def __init__(
+        self,
+        clock: Any,
+        max_preemptions_per_run: int = 3,
+        durable_egress: bool = False,
+    ):
         self.clock = clock
         self.max_preemptions_per_run = max(1, int(max_preemptions_per_run))
+        # sink-carrying runs are admissible victims only when their
+        # egress is DURABLE (the service runs with a checkpoint path,
+        # so the writer's span cursor survives the cancel and the
+        # re-execution resumes mid-artifact). Preempting a sink run
+        # without that would restart its egress from row zero — worse
+        # than making the demand wait.
+        self.durable_egress = bool(durable_egress)
         self._lock = threading.Lock()
         self._running: List[_RunningGroup] = []
 
@@ -170,6 +182,13 @@ class PreemptionController:
         eligible = len(group) == 1 and all(
             t.handle.priority >= Priority.BATCH
             and t.preemptions < self.max_preemptions_per_run
+            and (
+                self.durable_egress
+                or getattr(
+                    getattr(t, "payload", None), "row_level_sink", None
+                )
+                is None
+            )
             for t in group
         )
         record = _RunningGroup(group, self.clock.now(), eligible)

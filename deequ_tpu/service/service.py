@@ -86,8 +86,12 @@ class RunRequest:
     result_key: Any = None
     #: egress.RowLevelSink — stream this run's row-level outcomes to a
     #: clean/quarantine parquet split (docs/EGRESS.md). Sink runs never
-    #: coalesce (the artifact is per-run) and always execute in-process
-    #: (the writer's file handles cannot cross a spawn boundary).
+    #: coalesce (the artifact is per-run) but otherwise ride the full
+    #: resilience stack: they checkpoint/resume through the durable
+    #: span segments, execute in the spawn child under crash isolation
+    #: (the child writes the artifact dir directly and streams egress
+    #: progress frames back), and are preemptible when the service has
+    #: a checkpoint path (docs/EGRESS.md "Durable egress").
     row_level_sink: Any = None
     #: explicit device-footprint estimate (bytes) for the elastic
     #: placement policy; None = derive from ``dataset`` at admit when
@@ -299,6 +303,9 @@ class VerificationService:
                 max_preemptions_per_run=(
                     opts.service_preempt_max_per_run
                 ),
+                # sink runs are admissible victims only when their
+                # egress cursor is durable (checkpointing service)
+                durable_egress=self._checkpoint_path is not None,
             )
         self.scheduler = Scheduler(
             self.queue,
@@ -704,6 +711,10 @@ class VerificationService:
                 last_checkpoint=entry.get("last_checkpoint"),
                 preempted=bool(entry.get("preempted")),
                 preempt_count=int(entry.get("preempt_count") or 0),
+                # a re-admitted sink run resumes MID-ARTIFACT: its
+                # durable span segments + egress cursor survive the
+                # restart alongside the scan checkpoint
+                egress=bool(request.row_level_sink is not None),
             )
         if recovered:
             tm.counter("service.runs_recovered").inc(len(recovered))
@@ -845,11 +856,6 @@ class VerificationService:
         holds closures that cannot cross a process boundary (the caller
         then falls back to in-process execution, loudly)."""
         request: RunRequest = ticket.payload
-        if request.row_level_sink is not None:
-            # the sink's writer owns local file handles and the report
-            # must land on the SUBMITTING process's sink object — run
-            # in-process (the fallback path logs the decision)
-            return None
         payload = {
             "run_id": ticket.handle.run_id,
             "dataset_key": request.dataset_key,
@@ -857,6 +863,12 @@ class VerificationService:
             "checks": list(request.checks),
             "required_analyzers": list(request.required_analyzers),
             "checkpoint_path": self._checkpoint_path,
+            # the sink dataclass is spawn-safe (the child builds its
+            # own QuarantineWriter over the artifact dir); the child's
+            # EgressReport rides back on result.row_level_egress and is
+            # re-stamped onto the SUBMITTING process's sink object by
+            # _execute_isolated
+            "row_level_sink": request.row_level_sink,
             "deadline_s": (
                 ticket.budget.remaining()
                 if ticket.budget is not None
@@ -912,6 +924,13 @@ class VerificationService:
             # data, so the floored result is an empty one that carries
             # the crash provenance instead of failing the handle
             return _crash_loop_result(exc, policy)
+        if request.row_level_sink is not None:
+            # the child ran with a pickled COPY of the sink — land the
+            # report on the submitting process's object, where callers
+            # (and docs) expect it
+            request.row_level_sink.report = getattr(
+                result, "row_level_egress", None
+            )
         self.plans.record_run(getattr(result, "telemetry", None))
         return result
 
@@ -1268,9 +1287,10 @@ def _isolated_execute(payload: Dict[str, Any]):
     """Child-process entry for one isolated verification run (module
     level: spawn pickles it by reference). Rebuilds the dataset from
     its factory, attaches a checkpointer over the service's durable
-    checkpoint path — so a relaunched child resumes mid-scan — and
-    strips ``_data`` from the result (device buffers do not cross the
-    pipe; row-level export needs an in-process run). The run listens on
+    checkpoint path — so a relaunched child resumes mid-scan (a
+    row-level sink resumes mid-ARTIFACT via its durable span cursor) —
+    and strips ``_data`` from the result (device buffers do not cross
+    the pipe). The run listens on
     the child-side cancel token: a parent-sent preemption (or client
     cancel) exits the scan cleanly at the next batch boundary, final
     cursor persisted."""
@@ -1286,6 +1306,10 @@ def _isolated_execute(payload: Dict[str, Any]):
         engine=engine,
         deadline=payload.get("deadline_s"),
         cancel=child_cancel_token(),
+        # the sink writes the artifact dir directly from this child;
+        # durable span segments + the checkpoint's egress cursor let a
+        # relaunched child resume the artifact mid-write
+        row_level_sink=payload.get("row_level_sink"),
     )
     result._data = None
     return result

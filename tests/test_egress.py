@@ -324,17 +324,33 @@ class TestPlanningAndLimits:
         assert report.status == "no_row_level_constraints"
         assert not os.path.exists(str(tmp_path / "egress" / "clean"))
 
-    def test_checkpointer_composition_is_refused(self, tmp_path):
-        from deequ_tpu.egress import plan_row_sink
+    def test_checkpointer_composition_now_runs(self, tmp_path):
+        """Regression for the lifted refusal (docs/EGRESS.md "Durable
+        egress"): plan_row_sink + a checkpointing engine no longer
+        raises — the composed run completes, checkpoints durably
+        mid-scan, and the artifact still matches the oracle."""
+        from deequ_tpu.io.state_provider import ScanCheckpointer
 
-        data = _make_data(100)
-        engine = types.SimpleNamespace(checkpointer=object())
-        with pytest.raises(ValueError, match="checkpoint"):
-            plan_row_sink(
-                RowLevelSink(str(tmp_path / "e")),
-                _scan_checks(),
-                data,
-                engine,
+        data = _make_data()
+        engine = AnalysisEngine(
+            checkpointer=ScanCheckpointer(str(tmp_path / "ckpt"))
+        )
+        tm = get_telemetry()
+        before = tm.counter("engine.checkpoints_written").value
+        with config.configure(
+            batch_size=104, checkpoint_every_batches=3, **STREAMING
+        ):
+            result, report = _run_with_sink(
+                data, _scan_checks(), tmp_path, engine=engine
+            )
+        assert report.status == "complete"
+        assert tm.counter("engine.checkpoints_written").value > before
+        oracle = row_level_results(result.check_results, data).table
+        _, _, merged = _read_artifact(report)
+        for name in oracle.schema.names:
+            assert (
+                merged.column(name).to_pylist()
+                == oracle.column(name).to_pylist()
             )
 
     def test_bad_filtered_row_outcome_rejected(self, tmp_path):
@@ -344,7 +360,9 @@ class TestPlanningAndLimits:
 
 class TestServiceIntegration:
     """The sink is per-run state: service runs carrying one never
-    coalesce and never cross the subprocess-isolation boundary."""
+    coalesce (they do ride crash isolation now — the spawn child
+    writes the artifact dir directly; tests/test_egress_durability.py
+    drives that path)."""
 
     def test_sink_runs_refuse_to_coalesce(self):
         from deequ_tpu.service.coalesce import CoalescePolicy

@@ -1,0 +1,741 @@
+"""Exactly-once row-level egress under process death (docs/EGRESS.md
+"Durable egress", docs/RESILIENCE.md): the chaos differentials.
+
+A child process is hard-killed at each adversarial point the design
+calls out — MID-SPAN (rows consumed past the last durable flush),
+POST-FLUSH-PRE-CURSOR (the span segment is durable but the checkpoint
+cursor naming it never landed), and MID-FINALIZE (compaction torn
+half-way through writing the public split) — and the relaunched run
+must publish a clean/quarantine split BYTE-identical to an
+uninterrupted oracle, with zero duplicate ``__row_index__`` values,
+conserved row counters, and ``engine.egress_rows_replayed`` pinned at
+0 (the flush-THEN-cursor ordering means a resume never re-emits a row
+the previous attempt already made durable).
+
+The same contract is then driven through every composition surface the
+sink now rides: service restart recovery (``VerificationService
+.recover()`` over the journal after the whole daemon dies by SIGKILL),
+checkpoint-conserving preemption (a solo BATCH egress run is a victim
+only when the service has a durable checkpoint plane), and crash
+isolation (the spawn child streams the artifact dir directly and a
+relaunched child resumes it mid-write).
+
+Every child entry point is module-level (spawn pickles by reference);
+crash-once semantics cross the relaunch boundary via fsync'd token
+files, never in-memory state — the same discipline as
+tests/test_crash_recovery.py.
+"""
+
+import functools
+import json
+import multiprocessing
+import os
+import pickle
+import signal
+import types
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu import Check, CheckLevel, config
+from deequ_tpu.data import Dataset
+from deequ_tpu.egress import RowLevelSink
+from deequ_tpu.engine.deadline import ManualClock
+from deequ_tpu.engine.subproc import (
+    CrashLoopError,
+    IsolatedRunner,
+    checkpoint_progress_probe,
+    reset_breakers,
+)
+from deequ_tpu.service import (
+    Priority,
+    RunRequest,
+    RunState,
+    VerificationService,
+)
+from deequ_tpu.telemetry import get_telemetry
+
+
+@pytest.fixture(autouse=True)
+def _reaped_and_reset():
+    reset_breakers()
+    yield
+    assert multiprocessing.active_children() == []
+    reset_breakers()
+
+
+def _egress_data(n=1000, seed=7):
+    """Plain-dict twin of tests/test_egress.py's dataset: nulls in
+    ``s`` and out-of-range ``v`` values guarantee BOTH splits are
+    non-empty. Picklable, so it crosses the spawn boundary."""
+    rng = np.random.default_rng(seed)
+    v = rng.integers(0, 120, size=n)
+    s = [
+        None if rng.random() < 0.08 else f"u{int(x):03d}@ex.com"
+        for x in rng.integers(0, 40, size=n)
+    ]
+    u = rng.integers(0, n // 2, size=n)
+    return {
+        "v": [int(x) for x in v],
+        "s": s,
+        "u": [int(x) for x in u],
+    }
+
+
+def _egress_checks(deferred=False, picklable=False):
+    check = (
+        Check(CheckLevel.ERROR, "durable egress")
+        .is_complete("s")
+        .satisfies("v < 90", "v_small")
+        .where("v >= 10")
+    )
+    if not picklable:
+        # has_pattern holds a closure: fine everywhere except the
+        # isolated-service path, whose checks must cross spawn
+        check = check.has_pattern("s", r"@ex\.com$")
+    checks = [check]
+    if deferred:
+        checks.append(
+            Check(CheckLevel.WARNING, "deferred").is_unique("u")
+        )
+    return checks
+
+
+def _split_bytes(out_dir):
+    out = {}
+    for split in ("clean", "quarantine"):
+        path = os.path.join(out_dir, split, "part-00000.parquet")
+        with open(path, "rb") as fh:
+            out[split] = fh.read()
+    return out
+
+
+def _manifest(out_dir):
+    path = os.path.join(out_dir, "manifest.json")
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    # the split paths embed the per-test tmp dir; everything else must
+    # match the oracle exactly
+    payload.pop("clean", None)
+    payload.pop("quarantine", None)
+    return payload
+
+
+def _assert_exactly_once(out_dir, rows_total):
+    """The artifact covers every input row exactly once: no duplicate
+    ``__row_index__`` anywhere across the split, no gaps."""
+    indexes = []
+    for split in ("clean", "quarantine"):
+        table = pq.read_table(
+            os.path.join(out_dir, split, "part-00000.parquet"),
+            columns=["__row_index__"],
+        )
+        indexes.extend(table.column("__row_index__").to_pylist())
+    assert len(indexes) == len(set(indexes)), "duplicate rows emitted"
+    assert sorted(indexes) == list(range(rows_total))
+
+
+# --------------------------------------------------------------------------
+# Spawn-child entry points (module level: pickled by reference)
+# --------------------------------------------------------------------------
+
+
+def _crash_once_token(token):
+    """Pay ONE hard crash across the relaunch chain: fsync a marker
+    before dying so the relaunched child sees the crash already
+    happened. Returns only when the crash was already paid."""
+    from deequ_tpu.testing.faults import hard_crash
+
+    if token is None or os.path.exists(token):
+        return
+    with open(token, "x", encoding="utf-8") as fh:
+        fh.write("crashed\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    hard_crash()
+
+
+def _sink_scan_child(payload):
+    """Run one sink-carrying verification in this process, with the
+    configured kill point armed (token-gated: the relaunch survives).
+    Returns the egress report's counters plus this process's replay
+    telemetry — the resumed launch must report zero replayed rows."""
+    from deequ_tpu.io import state_provider
+    from deequ_tpu.engine.scan import AnalysisEngine
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    ds = Dataset.from_pydict(payload["data"])
+    token = payload.get("crash_token_path")
+    kill = payload.get("kill")
+    if kill == "mid_span":
+        # die producing batch 7: two batches of rows sit in the OPEN
+        # span, past the cursor checkpointed after batch 5
+        from deequ_tpu.testing.faults import FaultInjectingDataset
+
+        ds = FaultInjectingDataset(
+            ds, crash_at_batch=7, crash_token_path=token
+        )
+    if kill == "post_flush_pre_cursor":
+        # _write_checkpoint flushes the span durably THEN saves the
+        # cursor: dying at save entry is exactly the window where the
+        # segment exists but no cursor names it — resume must discard
+        # the orphaned segment and re-emit it, never double-publish
+        real_save = state_provider.ScanCheckpointer.save
+        calls = {"n": 0}
+
+        def crashing_save(self, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                _crash_once_token(token)
+            return real_save(self, *args, **kwargs)
+
+        state_provider.ScanCheckpointer.save = crashing_save
+    if kill == "mid_finalize":
+        # die during compaction, AFTER the first segment was already
+        # routed into the public split writers: the relaunch finds a
+        # torn clean/part file and must wipe it, not append to it
+        from deequ_tpu.egress import writer as writer_mod
+
+        real_read = writer_mod.QuarantineWriter._read_segment_payload
+        calls = {"n": 0}
+
+        def crashing_read(self, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                _crash_once_token(token)
+            return real_read(self, *args, **kwargs)
+
+        writer_mod.QuarantineWriter._read_segment_payload = crashing_read
+
+    sink = RowLevelSink(
+        payload["out_dir"],
+        tenant=payload.get("tenant", "acme"),
+        run_id=payload.get("run_id", "r1"),
+    )
+    cfg = dict(
+        batch_size=104,
+        checkpoint_every_batches=3,
+        device_cache_bytes=(
+            (1 << 30) if payload["mode"] == "resident" else 0
+        ),
+    )
+    with config.configure(**cfg):
+        result = VerificationSuite.do_verification_run(
+            ds,
+            _egress_checks(
+                deferred=payload.get("deferred", False),
+                picklable=payload.get("picklable", False),
+            ),
+            engine=AnalysisEngine(
+                checkpointer=state_provider.ScanCheckpointer(
+                    payload["ckpt_path"]
+                )
+            ),
+            row_level_sink=sink,
+        )
+    report = result.row_level_egress
+    tm = get_telemetry()
+    return {
+        "status": report.status,
+        "rows_total": report.rows_total,
+        "rows_clean": report.rows_clean,
+        "rows_quarantined": report.rows_quarantined,
+        "rows_replayed": tm.counter("engine.egress_rows_replayed").value,
+        "spans_flushed": tm.counter("engine.egress_spans_flushed").value,
+        "segments_compacted": tm.counter(
+            "engine.egress_segments_compacted"
+        ).value,
+    }
+
+
+def _egress_service_victim(payload):
+    """A whole service daemon that dies by SIGKILL mid-egress: the run
+    has streamed two durable span segments (and their cursors) into
+    the artifact dir when the kill lands at batch 7. Never returns."""
+    from deequ_tpu.testing.faults import FaultInjectingDataset
+
+    ds = FaultInjectingDataset(
+        Dataset.from_pydict(payload["data"]),
+        crash_at_batch=7,
+        crash_signum=signal.SIGKILL,
+    )
+    svc = VerificationService(
+        workers=1, isolated=False, journal_dir=payload["journal_dir"]
+    ).start()
+    with config.configure(
+        checkpoint_every_batches=3, batch_size=104, device_cache_bytes=0
+    ):
+        handle = svc.submit(
+            RunRequest(
+                tenant="acme",
+                checks=tuple(_egress_checks()),
+                dataset=ds,
+                row_level_sink=RowLevelSink(
+                    payload["out_dir"], tenant="acme", run_id="r1"
+                ),
+                priority=Priority.STANDARD,
+            )
+        )
+        handle.wait(timeout=120)  # the SIGKILL lands first
+    return "unreachable"
+
+
+def _crashy_dict_factory(data, token):
+    """Dataset factory for the ISOLATED service path: runs in the
+    spawn child, configures the child's scan geometry (config does not
+    cross the spawn boundary), and arms a token-gated hard crash at
+    batch 7 — the relaunched child resumes the artifact mid-write."""
+    from deequ_tpu.testing.faults import FaultInjectingDataset
+
+    config.set_option(
+        batch_size=104, checkpoint_every_batches=3, device_cache_bytes=0
+    )
+    return FaultInjectingDataset(
+        Dataset.from_pydict(data),
+        crash_at_batch=7,
+        crash_token_path=token,
+    )
+
+
+# --------------------------------------------------------------------------
+# SIGKILL at each adversarial point → byte-identical split
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["resident", "streaming"])
+@pytest.mark.parametrize(
+    "kill", ["mid_span", "post_flush_pre_cursor", "mid_finalize"]
+)
+class TestKillPointDifferential:
+    def test_kill_then_resume_byte_identical(self, tmp_path, mode, kill):
+        data = _egress_data()
+        ref_out = str(tmp_path / "ref-out")
+        ref = _sink_scan_child(
+            {
+                "mode": mode,
+                "data": data,
+                "ckpt_path": str(tmp_path / "ref-ckpt"),
+                "out_dir": ref_out,
+            }
+        )
+        assert ref["status"] == "complete"
+        assert ref["rows_quarantined"] > 0  # both splits non-trivial
+
+        ckpt_path = str(tmp_path / "ckpt")
+        out_dir = str(tmp_path / "out")
+        tm = get_telemetry()
+        crashes_before = tm.counter("engine.child_crashes").value
+        runner = IsolatedRunner(
+            key=f"egress:{mode}:{kill}",
+            max_relaunches=3,
+            timeout_s=300.0,
+            progress_probe=checkpoint_progress_probe(ckpt_path),
+            use_breaker=False,
+        )
+        got = runner.run(
+            _sink_scan_child,
+            {
+                "mode": mode,
+                "data": data,
+                "kill": kill,
+                "ckpt_path": ckpt_path,
+                "out_dir": out_dir,
+                "crash_token_path": str(tmp_path / "crash-token"),
+            },
+        )
+        # the kill actually happened, and one relaunch finished it
+        assert (
+            tm.counter("engine.child_crashes").value - crashes_before == 1
+        )
+        assert got["status"] == "complete"
+        # the exactly-once pin: the resumed launch re-emitted nothing
+        # that was already durable
+        assert got["rows_replayed"] == 0
+        assert got["rows_total"] == ref["rows_total"] == 1000
+        assert got["rows_clean"] == ref["rows_clean"]
+        assert got["rows_quarantined"] == ref["rows_quarantined"]
+        # the published artifact is BYTE-identical to the oracle's
+        assert _split_bytes(out_dir) == _split_bytes(ref_out)
+        assert _manifest(out_dir) == _manifest(ref_out)
+        _assert_exactly_once(out_dir, got["rows_total"])
+        # finalize swept the private spool/segment plane
+        assert not os.path.exists(os.path.join(out_dir, "spans"))
+        assert not os.path.exists(
+            os.path.join(out_dir, "_scan_bits.spool")
+        )
+
+
+class TestDeferredSpoolDurability:
+    def test_streaming_deferred_kill_mid_span(self, tmp_path):
+        """The deferred-family spool (streaming + is_unique: row bits
+        spilled to ``_scan_bits.spool``) rides the same cursor: the
+        killed run's spool is truncated to the durable offset on
+        resume and the deferred outcomes still match the oracle."""
+        data = _egress_data()
+        ref_out = str(tmp_path / "ref-out")
+        ref = _sink_scan_child(
+            {
+                "mode": "streaming",
+                "data": data,
+                "deferred": True,
+                "ckpt_path": str(tmp_path / "ref-ckpt"),
+                "out_dir": ref_out,
+            }
+        )
+        assert ref["status"] == "complete"
+        out_dir = str(tmp_path / "out")
+        ckpt_path = str(tmp_path / "ckpt")
+        runner = IsolatedRunner(
+            key="egress:spool",
+            max_relaunches=3,
+            timeout_s=300.0,
+            progress_probe=checkpoint_progress_probe(ckpt_path),
+            use_breaker=False,
+        )
+        got = runner.run(
+            _sink_scan_child,
+            {
+                "mode": "streaming",
+                "data": data,
+                "deferred": True,
+                "kill": "mid_span",
+                "ckpt_path": ckpt_path,
+                "out_dir": out_dir,
+                "crash_token_path": str(tmp_path / "crash-token"),
+            },
+        )
+        assert got["status"] == "complete"
+        assert got["rows_replayed"] == 0
+        assert _split_bytes(out_dir) == _split_bytes(ref_out)
+        _assert_exactly_once(out_dir, got["rows_total"])
+
+
+# --------------------------------------------------------------------------
+# Service restart recovery: SIGKILLed daemon → recover() → same bytes
+# --------------------------------------------------------------------------
+
+
+class TestServiceRestartRecovery:
+    def test_sigkilled_egress_run_recovers_byte_identical(self, tmp_path):
+        data = _egress_data()
+        journal_dir = str(tmp_path / "journal")
+        out_dir = str(tmp_path / "out")
+        victim = IsolatedRunner(
+            key="egress-victim",
+            max_relaunches=1,
+            timeout_s=300.0,
+            use_breaker=False,
+        )
+        with pytest.raises(CrashLoopError) as excinfo:
+            victim.run(
+                _egress_service_victim,
+                {
+                    "data": data,
+                    "journal_dir": journal_dir,
+                    "out_dir": out_dir,
+                },
+            )
+        assert excinfo.value.last_signal == "SIGKILL"
+        # the durable span plane survived the kill alongside the
+        # journal: segments are there for the recovered run to keep
+        assert os.path.isdir(os.path.join(out_dir, "spans"))
+
+        oracle_out = str(tmp_path / "oracle-out")
+        oracle = _sink_scan_child(
+            {
+                "mode": "streaming",
+                "data": data,
+                "ckpt_path": str(tmp_path / "oracle-ckpt"),
+                "out_dir": oracle_out,
+            }
+        )
+        tm = get_telemetry()
+        resumes_before = tm.counter("engine.resumes").value
+        replayed_before = tm.counter("engine.egress_rows_replayed").value
+        with config.configure(
+            checkpoint_every_batches=3, batch_size=104, device_cache_bytes=0
+        ):
+            svc = VerificationService(
+                workers=1, isolated=False, journal_dir=journal_dir
+            )
+            recovered = svc.recover(
+                resolve=lambda rid, e: RunRequest(
+                    tenant=e["tenant"],
+                    checks=tuple(_egress_checks()),
+                    dataset=Dataset.from_pydict(data),
+                    row_level_sink=RowLevelSink(
+                        out_dir, tenant="acme", run_id="r1"
+                    ),
+                )
+            )
+            assert len(recovered) == 1
+            svc.start()
+            try:
+                handle = recovered[0]
+                assert handle.wait(timeout=120)
+                assert handle.status == RunState.DONE
+                result = handle.result(timeout=0)
+            finally:
+                svc.stop(drain=False, timeout=10)
+        # resumed from the dead daemon's cursor, re-emitting nothing
+        assert tm.counter("engine.resumes").value - resumes_before == 1
+        assert (
+            tm.counter("engine.egress_rows_replayed").value
+            == replayed_before
+        )
+        report = result.row_level_egress
+        assert report is not None and report.status == "complete"
+        assert report.rows_clean + report.rows_quarantined == 1000
+        assert _split_bytes(out_dir) == _split_bytes(oracle_out)
+        _assert_exactly_once(out_dir, report.rows_total)
+        assert oracle["rows_clean"] == report.rows_clean
+
+
+# --------------------------------------------------------------------------
+# Preemption: sink victims require the durable egress plane
+# --------------------------------------------------------------------------
+
+
+def _sink_ticket():
+    from deequ_tpu.service.queue import RunHandle, RunTicket
+
+    handle = RunHandle("run-s", "acme", Priority.BATCH)
+    return RunTicket(
+        seq=0,
+        handle=handle,
+        payload=types.SimpleNamespace(row_level_sink=object()),
+        budget=None,
+    )
+
+
+class TestPreemptEligibility:
+    def test_sink_victim_requires_durable_egress(self):
+        from deequ_tpu.service.preempt import PreemptionController
+
+        blind = PreemptionController(clock=ManualClock())
+        blind.register([_sink_ticket()])
+        # without a durable checkpoint plane a mid-egress preempt
+        # would tear the artifact: the sink run is not a victim
+        assert blind.preempt_for("needy") is False
+
+        durable = PreemptionController(
+            clock=ManualClock(), durable_egress=True
+        )
+        ticket = _sink_ticket()
+        durable.register([ticket])
+        assert durable.preempt_for("needy") is True
+        assert ticket.preempt_requested is True
+
+
+class TestPreemptedEgressRun:
+    ROWS = 200_000
+
+    def _factory(self):
+        rows = self.ROWS
+
+        def factory():
+            rng = np.random.default_rng(23)
+            return Dataset.from_pydict(
+                {
+                    "k1": [
+                        int(x)
+                        for x in rng.integers(0, 1 << 40, rows)
+                    ],
+                    "v1": [
+                        float(x) for x in rng.normal(0, 1, rows)
+                    ],
+                }
+            )
+
+        return factory
+
+    def _batch_checks(self):
+        return [
+            Check(CheckLevel.ERROR, "preempt-egress")
+            .is_complete("k1")
+            .satisfies("v1 < 1.5", "v1_bounded")
+        ]
+
+    def test_preempted_solo_batch_egress_conserved(self, tmp_path):
+        """The composition PR 18 refused: a solo BATCH run CARRYING A
+        SINK is preempted by interactive demand, requeued, resumed —
+        and the artifact is conserved (identical to an unpreempted
+        run, every row exactly once, zero replays)."""
+        factory = self._factory()
+        tm = get_telemetry()
+
+        def _submit(svc, sink, priority, key):
+            return svc.submit(
+                RunRequest(
+                    tenant="acme",
+                    checks=(
+                        tuple(self._batch_checks())
+                        if priority == Priority.BATCH
+                        else (
+                            Check(
+                                CheckLevel.ERROR, "quick"
+                            ).is_complete("k1"),
+                        )
+                    ),
+                    dataset_key=key,
+                    dataset_factory=factory,
+                    priority=priority,
+                    row_level_sink=sink,
+                )
+            )
+
+        solo_out = str(tmp_path / "solo-out")
+        out_dir = str(tmp_path / "out")
+        with config.configure(
+            batch_size=4096, checkpoint_every_batches=1,
+            device_cache_bytes=0,
+        ):
+            solo_svc = VerificationService(
+                workers=1, isolated=False, preemption=True,
+                journal_dir=str(tmp_path / "solo-journal"),
+            ).start()
+            try:
+                solo = _submit(
+                    solo_svc, RowLevelSink(solo_out), Priority.BATCH,
+                    "egress/solo",
+                )
+                assert solo.wait(timeout=120)
+                assert solo.status == RunState.DONE
+            finally:
+                solo_svc.stop(drain=False, timeout=30)
+
+            preempts_before = tm.counter("service.preemptions").value
+            replayed_before = tm.counter(
+                "engine.egress_rows_replayed"
+            ).value
+            svc = VerificationService(
+                workers=1, isolated=False, preemption=True,
+                journal_dir=str(tmp_path / "journal"),
+            ).start()
+            try:
+                batch = _submit(
+                    svc, RowLevelSink(out_dir), Priority.BATCH,
+                    "egress/batch",
+                )
+                assert _spin_until(
+                    lambda: batch.status == RunState.RUNNING
+                )
+                quick = _submit(
+                    svc, None, Priority.INTERACTIVE, "egress/quick"
+                )
+                assert quick.wait(timeout=120)
+                assert batch.wait(timeout=120)
+                assert batch.status == RunState.DONE
+                result = batch.result(timeout=0)
+            finally:
+                svc.stop(drain=False, timeout=30)
+
+        assert (
+            tm.counter("service.preemptions").value - preempts_before
+            == 1
+        )
+        assert (
+            tm.counter("engine.egress_rows_replayed").value
+            == replayed_before
+        )
+        report = result.row_level_egress
+        assert report is not None and report.status == "complete"
+        assert (
+            report.rows_clean + report.rows_quarantined == self.ROWS
+        )
+        solo_report = solo.result(timeout=0).row_level_egress
+        assert report.rows_clean == solo_report.rows_clean
+        assert report.rows_quarantined == solo_report.rows_quarantined
+        assert _split_bytes(out_dir) == _split_bytes(solo_out)
+        _assert_exactly_once(out_dir, self.ROWS)
+
+
+def _spin_until(predicate, timeout_s=60.0):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+# --------------------------------------------------------------------------
+# Crash isolation: the spawn child streams the artifact directly
+# --------------------------------------------------------------------------
+
+
+class TestIsolatedSinkExecution:
+    def test_spawn_child_crash_resumes_the_artifact(self, tmp_path):
+        """The composition PR 17 refused: a sink-carrying service run
+        executes in the SPAWN CHILD (no inline fallback), the child
+        hard-crashes mid-egress, and the relaunched child resumes the
+        artifact from the durable cursor — same bytes as an
+        uninterrupted run, report re-stamped onto the submitting
+        process's sink."""
+        data = _egress_data()
+        oracle_out = str(tmp_path / "oracle-out")
+        oracle = _sink_scan_child(
+            {
+                "mode": "streaming",
+                "data": data,
+                "picklable": True,
+                "ckpt_path": str(tmp_path / "oracle-ckpt"),
+                "out_dir": oracle_out,
+            }
+        )
+        assert oracle["status"] == "complete"
+
+        out_dir = str(tmp_path / "out")
+        sink = RowLevelSink(out_dir, tenant="acme", run_id="r1")
+        factory = functools.partial(
+            _crashy_dict_factory, data, str(tmp_path / "iso-token")
+        )
+        checks = tuple(_egress_checks(picklable=True))
+        # the whole point is the CHILD path: if any of this stopped
+        # pickling, the service would fall back inline and the armed
+        # crash would kill the test process itself
+        pickle.dumps((checks, factory, sink))
+
+        tm = get_telemetry()
+        crashes_before = tm.counter("engine.child_crashes").value
+        fallbacks_before = tm.counter(
+            "service.isolation_inline_fallbacks"
+        ).value
+        svc = VerificationService(
+            workers=1, isolated=True,
+            journal_dir=str(tmp_path / "journal"),
+        ).start()
+        try:
+            handle = svc.submit(
+                RunRequest(
+                    tenant="acme",
+                    checks=checks,
+                    dataset_key="iso-egress",
+                    dataset_factory=factory,
+                    row_level_sink=sink,
+                )
+            )
+            assert handle.wait(timeout=300)
+            assert handle.status == RunState.DONE
+            result = handle.result(timeout=0)
+        finally:
+            svc.stop(drain=False, timeout=10)
+        assert (
+            tm.counter("service.isolation_inline_fallbacks").value
+            == fallbacks_before
+        )
+        assert (
+            tm.counter("engine.child_crashes").value - crashes_before
+            == 1
+        )
+        report = result.row_level_egress
+        assert report is not None and report.status == "complete"
+        # the child's report landed on the submitting process's sink
+        assert sink.report is report
+        assert report.rows_clean == oracle["rows_clean"]
+        assert report.rows_quarantined == oracle["rows_quarantined"]
+        assert _split_bytes(out_dir) == _split_bytes(oracle_out)
+        _assert_exactly_once(out_dir, report.rows_total)

@@ -1586,3 +1586,119 @@ class TestPreemptDiscipline:
             if f.rule == "preempt-discipline"
         ]
         assert found == []
+
+
+# --------------------------------------------------------------------------
+# egress-durability: no cursor construction without a durable flush
+# --------------------------------------------------------------------------
+
+EGRESS_CURSOR_UNGUARDED = """
+from deequ_tpu.io.state_provider import EgressCursor
+
+class Writer:
+    def checkpoint(self):
+        # planted violation: the cursor is minted before anything was
+        # made durable — a crash here makes resume drop rows
+        return EgressCursor(
+            last_durably_flushed_span_seq=self.seq,
+            rows_emitted_clean=self.rows_clean,
+            rows_emitted_quarantined=self.rows_quarantined,
+            plane_spool_offset=0,
+        )
+"""
+
+EGRESS_CURSOR_CORRECTED = """
+import os
+
+from deequ_tpu.io.state_provider import EgressCursor
+
+class Writer:
+    def checkpoint(self):
+        self._finalize_open_segment()
+        return EgressCursor(
+            last_durably_flushed_span_seq=self.seq,
+            rows_emitted_clean=self.rows_clean,
+            rows_emitted_quarantined=self.rows_quarantined,
+            plane_spool_offset=0,
+        )
+
+    def checkpoint_spool(self):
+        os.fsync(self._spool.fileno())
+        return EgressCursor(
+            last_durably_flushed_span_seq=-1,
+            rows_emitted_clean=0,
+            rows_emitted_quarantined=0,
+            plane_spool_offset=self._spool.tell(),
+        )
+"""
+
+EGRESS_SCANCURSOR_UNGUARDED = """
+from deequ_tpu.io.state_provider import ScanCursor
+
+def save_cursor(ckpt, batch_index):
+    ckpt.save(ScanCursor(batch_index, 0, "fp", 104))
+"""
+
+EGRESS_NESTED_SCOPE = """
+from deequ_tpu.io.state_provider import EgressCursor
+
+class Writer:
+    def checkpoint(self):
+        self.flush_durable()
+
+        def later():
+            # the nested scope never flushed anything itself
+            return EgressCursor(
+                last_durably_flushed_span_seq=0,
+                rows_emitted_clean=0,
+                rows_emitted_quarantined=0,
+                plane_spool_offset=0,
+            )
+
+        return later
+"""
+
+
+class TestEgressDurability:
+    SCOPED_REL = "deequ_tpu/egress/fixture.py"
+
+    def test_catches_unguarded_cursor(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, EGRESS_CURSOR_UNGUARDED)
+        found = _rules_found(tmp_path, "egress-durability")
+        assert len(found) == 1
+        assert found[0].symbol == "EgressCursor"
+        assert "durable-flush" in found[0].message
+
+    def test_catches_unguarded_scan_cursor(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, EGRESS_SCANCURSOR_UNGUARDED)
+        found = _rules_found(tmp_path, "egress-durability")
+        assert len(found) == 1
+        assert found[0].symbol == "ScanCursor"
+
+    def test_silent_on_corrected_twin(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, EGRESS_CURSOR_CORRECTED)
+        assert _rules_found(tmp_path, "egress-durability") == []
+
+    def test_nested_function_needs_its_own_flush(self, tmp_path):
+        _write(tmp_path, self.SCOPED_REL, EGRESS_NESTED_SCOPE)
+        found = _rules_found(tmp_path, "egress-durability")
+        assert len(found) == 1
+        assert found[0].symbol == "EgressCursor"
+
+    def test_out_of_scope_module_is_silent(self, tmp_path):
+        # the engine's own ScanCursor assembly has its flush on the
+        # writer side; the rule scopes to the egress package only
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            EGRESS_CURSOR_UNGUARDED,
+        )
+        assert _rules_found(tmp_path, "egress-durability") == []
+
+    def test_shipped_tree_is_clean(self):
+        found = [
+            f
+            for f in unwaived(run_analyzers(REPO_ROOT))
+            if f.rule == "egress-durability"
+        ]
+        assert found == []

@@ -129,7 +129,9 @@ def render_egress(records: List[Dict[str, Any]]) -> str:
         and r.get("event") == "rowlevel_egress"
     ]
     if not events:
-        return ""
+        # an artifact can hold resumes with no finalize yet (every
+        # attempt so far was interrupted) — still worth a line
+        return render_egress_resume(records)
     lines = []
     for e in events:
         clean = int(e.get("rows_clean", 0))
@@ -153,7 +155,50 @@ def render_egress(records: List[Dict[str, Any]]) -> str:
         if tenant:
             parts.append(f"tenant {tenant}")
         lines.append("egress: " + ", ".join(parts))
+    resume_line = render_egress_resume(records)
+    if resume_line:
+        lines.append(resume_line)
     return "\n".join(lines)
+
+
+def render_egress_resume(records: List[Dict[str, Any]]) -> str:
+    """The durable-egress resume line (docs/EGRESS.md "Durable
+    egress"), one per artifact: how many interrupted sink runs resumed
+    from their span cursor, and the exactly-once pin —
+    ``rows_replayed`` summed over every resume, which the
+    flush-then-cursor ordering holds at 0. ``egress_resumed`` events
+    fire DURING the scan, so each lands in its run summary's event
+    list AND as a top-level event line; count the summary copy and
+    only fall back to top-level lines for runs with no summary (a
+    scan outside a run context, or a summary lost to a crash)."""
+    resumed: List[Dict[str, Any]] = []
+    summarized_runs = set()
+    for summary in load_runs(records):
+        summarized_runs.add(summary.get("run_id"))
+        resumed.extend(
+            e for e in summary.get("events", [])
+            if e.get("event") == "egress_resumed"
+        )
+    resumed.extend(
+        r for r in records
+        if r.get("type") == "event"
+        and r.get("event") == "egress_resumed"
+        and r.get("run_id") not in summarized_runs
+    )
+    if not resumed:
+        return ""
+    replayed = sum(int(e.get("rows_replayed", 0)) for e in resumed)
+    recovered = sum(
+        int(e.get("rows_clean", 0)) + int(e.get("rows_quarantined", 0))
+        for e in resumed
+    )
+    parts = [
+        f"{len(resumed)} resume(s) from span cursor",
+        f"{recovered:,} rows already durable",
+        f"{replayed:,} rows replayed"
+        + (" (exactly-once held)" if replayed == 0 else " (DUPLICATES)"),
+    ]
+    return "egress-resume: " + ", ".join(parts)
 
 
 def render_run(summary: Dict[str, Any]) -> str:

@@ -1,0 +1,143 @@
+"""Egress-durability analyzer: no cursor without a durable flush.
+
+The write-ahead invariant of exactly-once row-level egress
+(docs/EGRESS.md "Durable egress"): an ``EgressCursor`` — the durable
+high-water mark a resumed run trusts — may only be constructed, and a
+``ScanCursor`` only assembled, AFTER the span segment (and the plane
+spool) it names has been durably flushed. A call site that mints a
+cursor without flushing first can persist a cursor that points past
+the durable data; a crash then makes resume silently DROP the rows
+between the flush and the cursor.
+
+The rule is structural, the ``preempt-discipline`` pattern applied to
+egress: inside ``deequ_tpu/egress/``, every call to a name in the
+guarded set (``EgressCursor``, ``ScanCursor``) must be LEXICALLY
+PRECEDED, within the same enclosing function, by a durable-flush call
+(``flush_durable``, ``_finalize_open_segment``, ``fsync``, or
+``durable_replace``). Flow-insensitive on purpose: flush-then-cursor
+is written straight-line in the writer, so lexical order IS the
+ordering being protected.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+SCOPE_PREFIX = "deequ_tpu/egress/"
+
+#: cursor constructions/writes that need durable-flush evidence
+GUARDED_NAMES = frozenset({"EgressCursor", "ScanCursor"})
+#: any of these, earlier in the same function, licenses the cursor
+EVIDENCE_NAMES = frozenset(
+    {"flush_durable", "_finalize_open_segment", "fsync", "durable_replace"}
+)
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """The last path segment of the called name ('fsync' for
+    ``os.fsync(...)``, 'EgressCursor' for a bare constructor), or None
+    for computed callees."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _function_sites(
+    tree: ast.AST,
+) -> Iterable[Tuple[Optional[ast.AST], List[ast.Call]]]:
+    """(enclosing function, calls directly inside it) pairs; calls in
+    nested functions belong to the NESTED function (each scope must
+    establish its own evidence), module-level calls to None."""
+    functions = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    owner: dict[int, ast.AST] = {}
+    for fn in functions:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                # innermost function wins: walk visits outer functions
+                # first, so a later (nested) owner overwrites
+                owner[id(node)] = fn
+    by_fn: dict[int, List[ast.Call]] = {}
+    module_level: List[ast.Call] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = owner.get(id(node))
+        if fn is None:
+            module_level.append(node)
+        else:
+            by_fn.setdefault(id(fn), []).append(node)
+    for fn in functions:
+        yield fn, by_fn.get(id(fn), [])
+    if module_level:
+        yield None, module_level
+
+
+class EgressDurabilityAnalyzer(Analyzer):
+    name = "egressdur"
+    rules = ("egress-durability",)
+    description = (
+        "EgressCursor/ScanCursor constructions in deequ_tpu/egress/ "
+        "not preceded by a durable-flush call"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if not sf.rel.startswith(SCOPE_PREFIX) or sf.tree is None:
+                continue
+            for fn, calls in _function_sites(sf.tree):
+                evidence_lines = [
+                    c.lineno
+                    for c in calls
+                    if _call_name(c) in EVIDENCE_NAMES
+                ]
+                first_evidence = (
+                    min(evidence_lines) if evidence_lines else None
+                )
+                for call in calls:
+                    name = _call_name(call)
+                    if name not in GUARDED_NAMES:
+                        continue
+                    if (
+                        first_evidence is not None
+                        and first_evidence < call.lineno
+                    ):
+                        continue
+                    where = (
+                        f"function {getattr(fn, 'name', '?')!r}"
+                        if fn is not None
+                        else "module level"
+                    )
+                    yield Finding(
+                        rule="egress-durability",
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            f"{name}(...) at {where} without a "
+                            "preceding durable-flush call "
+                            "(flush_durable/_finalize_open_segment/"
+                            "fsync/durable_replace) — a cursor written "
+                            "before its span is durable makes resume "
+                            "drop rows (docs/EGRESS.md "
+                            '"Durable egress")'
+                        ),
+                        symbol=name,
+                    )
+
+
+register(EgressDurabilityAnalyzer())
