@@ -394,6 +394,36 @@ class Options:
         )
         or 1.0
     )
+    # fleet failover (service/fleet.py, docs/SERVICE.md "Fleet
+    # failover"): a non-empty shared fleet dir turns each journaling
+    # replica into a fleet member — heartbeat lease + peer watch +
+    # orphan adoption + epoch fencing. "" (default) = solo replica,
+    # every fleet path byte-identical to the pre-fleet service.
+    service_fleet_dir: str = os.environ.get(
+        "DEEQU_TPU_SERVICE_FLEET_DIR", ""
+    )
+    # replica identity in the fleet dir's lease namespace; "" derives
+    # replica-<pid> (fine for single-host loopback fleets, set it
+    # explicitly for real deployments so adoption provenance is stable)
+    service_fleet_replica: str = os.environ.get(
+        "DEEQU_TPU_SERVICE_FLEET_REPLICA", ""
+    )
+    service_fleet_heartbeat_s: float = float(
+        os.environ.get("DEEQU_TPU_SERVICE_FLEET_HEARTBEAT", 2.0) or 2.0
+    )
+    # how long a peer's (epoch, stamp) pair may sit unchanged on the
+    # OBSERVER's clock before the lease is declared dead and adoption
+    # races begin; must comfortably exceed heartbeat_s (the default
+    # survives ~5 missed beats)
+    service_fleet_lease_timeout_s: float = float(
+        os.environ.get("DEEQU_TPU_SERVICE_FLEET_LEASE_TIMEOUT", 10.0)
+        or 10.0
+    )
+    # distinct replicas a plan key must crash-loop before the shared
+    # breaker ledger quarantines it fleet-wide at adoption time
+    service_fleet_poison_replicas: int = int(
+        os.environ.get("DEEQU_TPU_SERVICE_FLEET_POISON_REPLICAS", 2) or 2
+    )
 
     def accumulation_float(self):
         import jax.numpy as jnp

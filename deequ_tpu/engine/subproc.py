@@ -62,6 +62,15 @@ CHILD_PLATFORM_ENV = "DEEQU_TPU_CHILD_JAX_PLATFORM"
 #: result tuple, so the parent still sees where a crashed child died.
 CHILD_TRACE_ENV = "DEEQU_TPU_CHILD_TRACE"
 
+#: env var carrying the parent replica's fleet epoch guard across the
+#: spawn boundary (JSON: fleet_dir / replica / epoch, built by
+#: ``FleetSupervisor.child_guard()``). A child re-reads the named lease
+#: chain before each durable persist: if the chain has moved past the
+#: shipped epoch the PARENT was fenced — a survivor adopted its runs —
+#: and the child must stop persisting too (``child_epoch_fenced``),
+#: or the zombie pair would rewind the adopter's cursors.
+CHILD_EPOCH_ENV = "DEEQU_TPU_CHILD_EPOCH"
+
 
 class ProcessCrashed(TransientScanError):
     """The child process died without delivering a result — killed by a
@@ -348,6 +357,48 @@ def child_cancel_token() -> CancelToken:
     return _child_cancel
 
 
+def child_epoch_fenced() -> bool:
+    """True when this process carries a fleet epoch guard
+    (``CHILD_EPOCH_ENV``) whose lease chain has moved past the shipped
+    epoch — the parent replica was fenced, so this child must drop its
+    durable persists too. False when no guard is set (no fleet) or the
+    guard cannot be evaluated (an unreadable fleet dir must not stall a
+    healthy child: the parent-side fence still protects the journal).
+
+    Imports the storage layer lazily and re-reads the chain on every
+    call — callers sit on checkpoint-interval cadence, not the batch
+    hot path."""
+    raw = os.environ.get(CHILD_EPOCH_ENV, "")
+    if not raw:
+        return False
+    try:
+        import json
+
+        guard = json.loads(raw)
+        fleet_dir = guard["fleet_dir"]
+        replica = guard["replica"]
+        epoch = int(guard["epoch"])
+        from deequ_tpu.io.storage import storage_for
+
+        storage = storage_for(fleet_dir)
+        # mirrors service/fleet.py's lease layout (LEASE_DIR/_lease_key)
+        # without importing service machinery into the child
+        prefix = f"leases/lease-{replica}-"
+        for key in storage.list_keys(prefix):
+            blob = storage.read_bytes(key)
+            if blob is None:
+                continue
+            body = json.loads(blob)
+            if (
+                body.get("replica") == replica
+                and int(body.get("epoch", 0)) > epoch
+            ):
+                return True
+        return False
+    except Exception:  # noqa: BLE001 — unevaluable guard: stay open
+        return False
+
+
 def _child_trace(tm: Any) -> Optional[Any]:
     """Decode the parent's shipped trace (``CHILD_TRACE_ENV``) into the
     child's ambient context, re-tagged with a ``/child`` process label
@@ -522,6 +573,7 @@ class IsolatedRunner:
         use_breaker: bool = True,
         clock: Optional[Any] = None,
         cancel_token: Optional[CancelToken] = None,
+        epoch_guard: Optional[str] = None,
     ):
         from deequ_tpu import config
 
@@ -549,6 +601,11 @@ class IsolatedRunner:
         # egress advancement between scan checkpoints also counts as
         # forward progress for the crash-loop budget (run())
         self._last_egress_frame: Optional[Dict[str, Any]] = None
+        # fleet epoch guard (CHILD_EPOCH_ENV): shipped to every child
+        # this runner launches so a child of a fenced parent stops
+        # persisting too (FleetSupervisor.child_guard() JSON, or None
+        # when the parent is not a fleet member)
+        self.epoch_guard = epoch_guard
         self._ctx = multiprocessing.get_context("spawn")
 
     # -- single launch ---------------------------------------------------
@@ -590,6 +647,14 @@ class IsolatedRunner:
             os.environ[CHILD_TRACE_ENV] = shipped.encode()
         else:
             os.environ.pop(CHILD_TRACE_ENV, None)
+        # same snapshot-and-restore discipline for the fleet epoch
+        # guard: spawn captures the environment at start(), and a stale
+        # guard must never leak into a later fleet-less child
+        prev_epoch_env = os.environ.get(CHILD_EPOCH_ENV)
+        if self.epoch_guard:
+            os.environ[CHILD_EPOCH_ENV] = self.epoch_guard
+        else:
+            os.environ.pop(CHILD_EPOCH_ENV, None)
         try:
             proc.start()
         finally:
@@ -597,6 +662,10 @@ class IsolatedRunner:
                 os.environ.pop(CHILD_TRACE_ENV, None)
             else:
                 os.environ[CHILD_TRACE_ENV] = prev_trace_env
+            if prev_epoch_env is None:
+                os.environ.pop(CHILD_EPOCH_ENV, None)
+            else:
+                os.environ[CHILD_EPOCH_ENV] = prev_epoch_env
         child_conn.close()  # parent's copy; the child holds the real end
         cancel_recv.close()  # ditto for the control pipe's read end
         message = None

@@ -123,8 +123,18 @@ class LocalStorage(Storage):
                 os.unlink(tmp)
 
     def list_keys(self, prefix: str = "") -> List[str]:
+        # walk only the subtree the prefix's directory part names: a
+        # fleet lease listing ("leases/lease-...") must not pay for
+        # sibling trees like <fleet_dir>/checkpoints, whose file count
+        # grows with every run — fence checks sit on persist paths
+        base = self.root
+        if "/" in prefix:
+            sub = prefix.rsplit("/", 1)[0]
+            base = os.path.join(self.root, *sub.split("/"))
+            if not os.path.isdir(base):
+                return []
         out = []
-        for dirpath, _dirs, files in os.walk(self.root):
+        for dirpath, _dirs, files in os.walk(base):
             for name in files:
                 rel = os.path.relpath(
                     os.path.join(dirpath, name), self.root
@@ -248,6 +258,47 @@ def interprocess_lock(path: str) -> Iterator[None]:
             fcntl.flock(fd, fcntl.LOCK_UN)
     finally:
         os.close(fd)
+
+
+#: serializes compare_and_swap for backends that have no filesystem to
+#: flock (MemoryStorage): one process-wide lock is exactly the scope a
+#: mem:// namespace has
+_cas_memory_lock = threading.Lock()
+
+
+def compare_and_swap(
+    path_or_uri: str,
+    key: str,
+    expected: Optional[bytes],
+    new: bytes,
+) -> bool:
+    """Atomic read-compare-write of one blob: publish ``new`` under
+    ``key`` only if the blob currently holds exactly ``expected``
+    (``None`` = the key must not exist), returning whether the swap
+    won. This is the fleet lease primitive (service/fleet.py): two
+    survivors racing to adopt a dead replica's epoch both CAS the same
+    lease key and exactly one returns True.
+
+    Linearization: LocalStorage serializes through an
+    ``interprocess_lock`` sidecar next to the root (flock — kernel
+    drops it on process death, so a crashed CAS holder never wedges
+    the fleet); MemoryStorage serializes on a process-wide lock (its
+    namespace cannot outlive the process anyway). The winning write is
+    durable (fsync + dir fsync on local disks) — a lease that a peer
+    acted on must survive power loss."""
+    storage = storage_for(path_or_uri)
+    if isinstance(storage, LocalStorage):
+        lock_ctx = interprocess_lock(
+            os.path.join(storage.root, ".cas.lock")
+        )
+    else:
+        lock_ctx = _cas_memory_lock
+    with lock_ctx:
+        current = storage.read_bytes(key)
+        if current != expected:
+            return False
+        storage.write_bytes(key, new, durable=True)
+        return True
 
 
 def storage_for(path_or_uri: str) -> Storage:

@@ -1,0 +1,745 @@
+"""Fleet failover: heartbeat leases, orphan adoption, epoch fencing.
+
+Every robustness layer below this one protects a single process — the
+watchdog, crash isolation, the durable run journal, preemption, durable
+egress. This module is the fleet-level composition (docs/SERVICE.md
+"Fleet failover"): N service replicas share a *fleet directory* (any
+``io/storage.py`` backend), each holding a durable, epoch-numbered
+heartbeat lease there. A :class:`FleetSupervisor` renews its own lease
+on the injected service clock and watches every peer's; when a peer's
+lease goes stale the survivor ADOPTS the dead replica's journal
+directory — claims the orphan's lease chain under a new epoch with a
+compare-and-swap (exactly one adopter can win) and replays its
+``pending_runs()`` through the service's recover path, so started runs
+resume from their durable ``ScanCursor``s with zero recompute.
+
+The lease chain, concretely: replica ``r``'s lease at epoch ``E`` is
+the blob ``leases/lease-{r}-{E:08d}.json`` — a dedicated subdirectory,
+so chain reads never pay for sibling trees like the shared checkpoint
+dir. Claiming epoch ``E+1`` is a CAS-create of the next file in the
+chain (expected = absent) — never an overwrite of the current one — so
+a slow heartbeat can never clobber an adoption. Heartbeats are plain
+durable overwrites of the OWN epoch file bumping a ``stamp`` counter;
+expiry is judged by how long a peer's ``(epoch, stamp)`` pair has sat
+unchanged on the watcher's OWN clock, so no cross-host clock
+comparison ever happens.
+
+Adoption is write-ahead like everything else durable here: before the
+claim CAS, ``on_adopt_intent`` durably records the adoption intent
+(orphan chain + journal dir + claim epoch) in the ADOPTER's own
+journal. A claim alone is a terminal state nobody re-polls — so if the
+adopter dies between winning the CAS and journaling the orphan's runs,
+whoever adopts the ADOPTER's chain finds the unfinished intent and
+completes the adoption (service ``_finish_adoption``), and a
+``recover()`` of the same journal does the same. No run is ever
+stranded behind a half-done claim.
+
+Epoch fencing: a zombie — a replica revived after a GC pause or
+network partition during which a peer adopted it — discovers on its
+next fence check that its chain has a higher epoch it does not own,
+and must drop every journal/repository/manifest write from then on
+(the adopter owns those runs now). :func:`epoch_fence_check` is that
+guard; the ``fence-discipline`` staticcheck rule requires it lexically
+before every persist call in ``deequ_tpu/service/``, and
+``engine/subproc.py`` ships the epoch to child processes so a child of
+a fenced parent also stops persisting.
+
+Poison quarantine: a run that crash-loops is circuit-broken per
+process by ``engine/subproc.py``'s breaker — but a poison run adopted
+fleet-wide would crash every replica in turn. The supervisor keeps a
+shared breaker ledger (``poison-*.json``) of which DISTINCT replicas a
+plan key has crashed; at ``poison_replicas`` distinct victims the key
+is quarantined fleet-wide and adoption refuses to re-admit it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from deequ_tpu.engine.deadline import MonotonicClock
+from deequ_tpu.io.storage import Storage, compare_and_swap, storage_for
+from deequ_tpu.telemetry import get_telemetry
+
+#: leases live in their own subdirectory so chain listings walk ONLY
+#: lease files — the fleet dir also hosts ``checkpoints/``, whose file
+#: count grows with every run, and fence checks sit on persist paths.
+#: ``engine/subproc.py child_epoch_fenced`` mirrors this layout.
+LEASE_DIR = "leases"
+LEASE_PREFIX = "lease-"
+POISON_PREFIX = "poison-"
+
+#: lease lifecycle states. ``live`` — heartbeating owner; ``adopted`` —
+#: a survivor claimed this chain (terminal: the chain names a dead
+#: replica whose runs moved to the adopter's journal); ``retired`` —
+#: the owner stopped cleanly, nothing to adopt.
+LEASE_STATES = ("live", "adopted", "retired")
+
+
+class FencedReplica(RuntimeError):
+    """This replica's lease epoch has been superseded by an adopter:
+    it must not accept, execute, or persist anything. Raised by the
+    service's admission path; persist paths silently drop instead
+    (the write's rightful owner is the adopter)."""
+
+
+@dataclass
+class Lease:
+    """One parsed lease blob — the newest epoch of one replica chain."""
+
+    replica: str
+    epoch: int
+    stamp: int
+    owner: str
+    journal_dir: str
+    state: str = "live"
+
+    def body(self) -> bytes:
+        return json.dumps(
+            {
+                "replica": self.replica,
+                "epoch": self.epoch,
+                "stamp": self.stamp,
+                "owner": self.owner,
+                "journal_dir": self.journal_dir,
+                "state": self.state,
+            },
+            sort_keys=True,
+        ).encode()
+
+
+@dataclass
+class FleetAdoption:
+    """What :meth:`FleetSupervisor.poll` hands the adoption callback
+    after winning a lease CAS: the orphan chain's identity and journal
+    directory, plus how long the lease had been stale on the
+    adopter's clock when it was claimed."""
+
+    replica: str
+    epoch: int
+    journal_dir: str
+    stale_for_s: float
+
+
+def _lease_key(replica: str, epoch: int) -> str:
+    return f"{LEASE_DIR}/{LEASE_PREFIX}{replica}-{epoch:08d}.json"
+
+
+def _chain_prefix(replica: str = "") -> str:
+    return f"{LEASE_DIR}/{LEASE_PREFIX}{replica}{'-' if replica else ''}"
+
+
+def _parse_lease(raw: Optional[bytes]) -> Optional[Lease]:
+    if raw is None:
+        return None
+    try:
+        body = json.loads(raw)
+        return Lease(
+            replica=str(body["replica"]),
+            epoch=int(body["epoch"]),
+            stamp=int(body.get("stamp", 0)),
+            owner=str(body.get("owner", body["replica"])),
+            journal_dir=str(body.get("journal_dir", "")),
+            state=str(body.get("state", "live")),
+        )
+    except Exception:  # noqa: BLE001 — torn/foreign blob = no lease
+        return None
+
+
+def _poison_key(plan_key: str) -> str:
+    digest = hashlib.sha256(plan_key.encode()).hexdigest()[:16]
+    return f"{POISON_PREFIX}{digest}.json"
+
+
+class FleetSupervisor:
+    """One replica's membership in the fleet: owns this replica's
+    lease chain, watches every peer chain, and adopts expired ones.
+
+    Timing discipline matches the rest of ``service/``: ages are
+    measured on the INJECTED clock only (``MonotonicClock`` in
+    production, ``ManualClock`` in tests — drive :meth:`heartbeat` /
+    :meth:`poll` by hand); the optional background thread paces
+    itself on a ``threading.Event`` wait, never ``time.sleep``.
+
+    Not constructed directly in production — ``VerificationService``
+    builds one when ``fleet_dir`` is configured and wires
+    :meth:`poll`'s adoption callback into its recover path.
+    """
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        replica_id: str,
+        journal_dir: str,
+        *,
+        clock: Optional[Any] = None,
+        heartbeat_s: float = 2.0,
+        lease_timeout_s: float = 10.0,
+        poison_replicas: int = 2,
+        on_adopt: Optional[Callable[[FleetAdoption], Any]] = None,
+        on_adopt_intent: Optional[Callable[[FleetAdoption], Any]] = None,
+        on_adopt_lost: Optional[Callable[[FleetAdoption], Any]] = None,
+    ):
+        if not replica_id:
+            raise ValueError("replica_id must be non-empty")
+        self.fleet_dir = fleet_dir
+        self.replica_id = replica_id
+        self.journal_dir = journal_dir
+        self.heartbeat_s = float(heartbeat_s)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.poison_replicas = int(poison_replicas)
+        self.on_adopt = on_adopt
+        #: fired BEFORE the claim CAS: the service durably records the
+        #: adoption intent in its journal; raising here ABORTS the
+        #: claim (no durable intent -> no claim -> no run-loss window)
+        self.on_adopt_intent = on_adopt_intent
+        #: fired after a LOST claim CAS: the service marks the intent
+        #: done so a later adopter does not replay a race it lost
+        self.on_adopt_lost = on_adopt_lost
+        self._clock = clock or MonotonicClock()
+        self._storage: Storage = storage_for(fleet_dir)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.epoch = 0
+        self._stamp = 0
+        self._fenced = False
+        #: local-clock time of the last chain read that confirmed this
+        #: replica still owns its epoch — ``fenced()`` serves the
+        #: unfenced verdict from this cache for up to one heartbeat
+        #: interval, so per-persist fence checks cost no storage reads
+        self._fence_ok_at: Optional[float] = None
+        #: claims handed back by ``release_claim`` (fenced between the
+        #: CAS win and the replay): ``_try_adopt`` must not record them
+        self._released: set = set()
+        #: chain -> ((epoch, stamp), local clock time last CHANGED) —
+        #: staleness is judged against this, never a peer's clock
+        self._peer_seen: Dict[str, Any] = {}
+        self._adoptions: List[FleetAdoption] = []
+        self._races_lost = 0
+        self._register()
+
+    # -- own lease ------------------------------------------------------
+
+    def _chain_top(self, replica: str) -> Optional[Lease]:
+        """The newest-epoch lease of one chain (file names sort by
+        epoch, so the last key is the top)."""
+        keys = self._storage.list_keys(_chain_prefix(replica))
+        for key in reversed(keys):
+            lease = _parse_lease(self._storage.read_bytes(key))
+            # the prefix also matches chains whose id merely STARTS
+            # with ours ("a" vs "a-b"); trust the blob, not the key
+            if lease is not None and lease.replica == replica:
+                return lease
+        return None
+
+    def _register(self) -> None:
+        """Claim this replica's chain at (top epoch + 1). CAS-create so
+        a zombie twin re-registering concurrently cannot silently share
+        an epoch; bounded retries re-scan on each loss."""
+        tm = get_telemetry()
+        for _ in range(16):
+            top = self._chain_top(self.replica_id)
+            next_epoch = (top.epoch if top is not None else 0) + 1
+            lease = Lease(
+                replica=self.replica_id,
+                epoch=next_epoch,
+                stamp=0,
+                owner=self.replica_id,
+                journal_dir=self.journal_dir,
+                state="live",
+            )
+            if compare_and_swap(
+                self.fleet_dir,
+                _lease_key(self.replica_id, next_epoch),
+                None,
+                lease.body(),
+            ):
+                with self._lock:
+                    self.epoch = next_epoch
+                    self._stamp = 0
+                    self._fenced = False
+                    self._fence_ok_at = self._clock.now()
+                self._gc_chain(self.replica_id, keep_epoch=next_epoch)
+                tm.metrics.gauge("service.fleet.lease_epoch").set(next_epoch)
+                tm.event(
+                    "fleet_lease_claimed",
+                    replica=self.replica_id,
+                    epoch=next_epoch,
+                    journal_dir=self.journal_dir,
+                )
+                return
+        raise RuntimeError(
+            f"could not claim a lease epoch for {self.replica_id!r} "
+            f"in {self.fleet_dir!r} (16 CAS losses — is another "
+            "process registering under the same replica id in a "
+            "tight loop?)"
+        )
+
+    def heartbeat(self) -> bool:
+        """Renew the own lease (durable stamp bump) — unless the chain
+        has moved past our epoch, in which case we are fenced: return
+        False and renew nothing. Safe as a plain overwrite because
+        only the epoch's owner ever writes an existing lease file;
+        every other actor CAS-creates the NEXT epoch."""
+        tm = get_telemetry()
+        top = self._chain_top(self.replica_id)
+        with self._lock:
+            if top is None or top.epoch > self.epoch or (
+                top.epoch == self.epoch and top.owner != self.replica_id
+            ):
+                self._fenced = True
+            if self._fenced:
+                return False
+            self._fence_ok_at = self._clock.now()
+            self._stamp += 1
+            lease = Lease(
+                replica=self.replica_id,
+                epoch=self.epoch,
+                stamp=self._stamp,
+                owner=self.replica_id,
+                journal_dir=self.journal_dir,
+                state="live",
+            )
+        self._storage.write_bytes(
+            _lease_key(self.replica_id, lease.epoch),
+            lease.body(),
+            durable=True,
+        )
+        tm.counter("service.fleet.heartbeats").inc()
+        return True
+
+    def fenced(self) -> bool:
+        """Re-check ownership of the own chain. Sticky: once fenced,
+        always fenced — a superseded epoch is never reclaimed; the
+        process must restart to re-register. The UNFENCED verdict is
+        cached for one heartbeat interval on the injected clock (every
+        heartbeat refreshes it with a real chain read), so the fence
+        checks on persist paths — submit, checkpoint saves, terminal
+        records — cost no storage listing; the zombie window this
+        staleness admits is at most one heartbeat, the same cadence
+        the background loop re-checks at anyway."""
+        now = self._clock.now()
+        with self._lock:
+            if self._fenced:
+                return True
+            if (
+                self._fence_ok_at is not None
+                and (now - self._fence_ok_at) < self.heartbeat_s
+            ):
+                return False
+            my_epoch = self.epoch
+        top = self._chain_top(self.replica_id)
+        fenced_now = top is None or top.epoch > my_epoch or (
+            top.epoch == my_epoch and top.owner != self.replica_id
+        )
+        with self._lock:
+            if fenced_now:
+                self._fenced = True
+            else:
+                self._fence_ok_at = now
+        return fenced_now
+
+    def retire(self) -> None:
+        """Clean-stop marker: flip the own lease to ``retired`` so
+        peers skip the chain instead of adopting an empty journal
+        after the timeout. A fenced replica writes nothing."""
+        with self._lock:
+            if self._fenced:
+                return
+            lease = Lease(
+                replica=self.replica_id,
+                epoch=self.epoch,
+                stamp=self._stamp,
+                owner=self.replica_id,
+                journal_dir=self.journal_dir,
+                state="retired",
+            )
+        self._storage.write_bytes(
+            _lease_key(self.replica_id, lease.epoch),
+            lease.body(),
+            durable=True,
+        )
+        get_telemetry().event(
+            "fleet_lease_retired",
+            replica=self.replica_id,
+            epoch=lease.epoch,
+        )
+
+    # -- peer watch + adoption -----------------------------------------
+
+    def _chains(self) -> Dict[str, Lease]:
+        """chain id -> top lease, for every chain in the fleet dir."""
+        tops: Dict[str, Lease] = {}
+        for key in self._storage.list_keys(_chain_prefix()):
+            lease = _parse_lease(self._storage.read_bytes(key))
+            if lease is None:
+                continue
+            prev = tops.get(lease.replica)
+            if prev is None or lease.epoch > prev.epoch:
+                tops[lease.replica] = lease
+        return tops
+
+    def poll(self) -> List[FleetAdoption]:
+        """One watch cycle: refresh peer staleness clocks, adopt every
+        chain whose lease sat unchanged past ``lease_timeout_s``.
+        Returns the adoptions won THIS call (callbacks already fired).
+        Driven by the background thread in production, by hand in
+        tests and single-shot tools. A fenced replica never watches or
+        adopts: its own runs belong to its adopter, and a zombie
+        winning an adoption CAS only to stand down at the service's
+        fence check would strand the orphan's runs."""
+        with self._lock:
+            if self._fenced:
+                return []
+        tm = get_telemetry()
+        now = self._clock.now()
+        adopted: List[FleetAdoption] = []
+        chains = self._chains()
+        tm.metrics.gauge("service.fleet.peers").set(
+            sum(
+                1
+                for c in chains.values()
+                if c.replica != self.replica_id and c.state == "live"
+            )
+        )
+        for chain_id, lease in chains.items():
+            if chain_id == self.replica_id:
+                continue
+            if lease.state in ("retired", "adopted"):
+                self._peer_seen.pop(chain_id, None)
+                continue
+            mark = (lease.epoch, lease.stamp)
+            seen = self._peer_seen.get(chain_id)
+            if seen is None or seen[0] != mark:
+                self._peer_seen[chain_id] = (mark, now)
+                continue
+            stale_for = now - seen[1]
+            if stale_for <= self.lease_timeout_s:
+                continue
+            tm.event(
+                "fleet_lease_expired",
+                replica=chain_id,
+                epoch=lease.epoch,
+                stale_for_s=round(stale_for, 3),
+                observer=self.replica_id,
+            )
+            adoption = self._try_adopt(lease, stale_for)
+            if adoption is not None:
+                adopted.append(adoption)
+        return adopted
+
+    def _try_adopt(
+        self, lease: Lease, stale_for_s: float
+    ) -> Optional[FleetAdoption]:
+        """Claim a dead chain at (epoch + 1). The CAS-create is the
+        exactly-one-adopter guarantee: every racing survivor computes
+        the same next key, and the storage backend admits one write.
+
+        Write-ahead ordering: the ``on_adopt_intent`` callback lands a
+        durable adoption-intent record in the adopter's journal BEFORE
+        the CAS — an intent that fails aborts the claim (better to
+        lose the race than hold a claim no crash can recover), and a
+        claim whose replay never finishes is completed by whoever
+        adopts the adopter (the intent names the orphan journal)."""
+        if self.fenced():
+            return None
+        tm = get_telemetry()
+        claim = Lease(
+            replica=lease.replica,
+            epoch=lease.epoch + 1,
+            stamp=0,
+            owner=self.replica_id,
+            journal_dir=lease.journal_dir,
+            state="adopted",
+        )
+        adoption = FleetAdoption(
+            replica=lease.replica,
+            epoch=claim.epoch,
+            journal_dir=lease.journal_dir,
+            stale_for_s=stale_for_s,
+        )
+        if self.on_adopt_intent is not None:
+            try:
+                self.on_adopt_intent(adoption)
+            except Exception:  # noqa: BLE001 — no durable intent,
+                tm.counter(  # no claim: the run-loss window stays shut
+                    "service.fleet.adoption_intent_failures"
+                ).inc()
+                tm.event(
+                    "fleet_adoption_intent_failed",
+                    replica=lease.replica,
+                    epoch=claim.epoch,
+                    adopter=self.replica_id,
+                )
+                return None
+        won = compare_and_swap(
+            self.fleet_dir,
+            _lease_key(lease.replica, claim.epoch),
+            None,
+            claim.body(),
+        )
+        if not won:
+            self._races_lost += 1
+            self._peer_seen.pop(lease.replica, None)
+            tm.counter("service.fleet.adoption_races_lost").inc()
+            tm.event(
+                "fleet_adoption_race_lost",
+                replica=lease.replica,
+                epoch=claim.epoch,
+                loser=self.replica_id,
+            )
+            if self.on_adopt_lost is not None:
+                self.on_adopt_lost(adoption)
+            return None
+        self._peer_seen.pop(lease.replica, None)
+        if self.on_adopt is not None:
+            self.on_adopt(adoption)
+        with self._lock:
+            if (lease.replica, claim.epoch) in self._released:
+                # the service handed the claim back (fenced between
+                # the CAS win and the replay): the chain's previous
+                # epoch is the top again, still adoptable — record
+                # nothing, GC nothing
+                self._released.discard((lease.replica, claim.epoch))
+                return None
+            self._adoptions.append(adoption)
+        self._gc_chain(lease.replica, keep_epoch=claim.epoch)
+        tm.counter("service.fleet.adoptions").inc()
+        tm.event(
+            "fleet_adoption",
+            replica=lease.replica,
+            epoch=claim.epoch,
+            adopter=self.replica_id,
+            journal_dir=lease.journal_dir,
+            stale_for_s=round(stale_for_s, 3),
+        )
+        return adoption
+
+    def adopt_chain(
+        self, replica: str, journal_dir: str, stale_for_s: float = 0.0
+    ) -> Optional[FleetAdoption]:
+        """Claim ``replica``'s chain at its next epoch REGARDLESS of
+        lease state — the finish-an-incomplete-adoption path (service
+        ``_finish_adoption``): a dead adopter's journaled intent names
+        a chain whose top is terminally ``adopted``, which ``poll``
+        rightly skips forever; finishing it means claiming the NEXT
+        epoch (the CAS keeps finishers unique) and replaying the
+        orphan journal again — already-adopted runs are terminal
+        there, so only the stranded ones re-admit."""
+        if replica == self.replica_id:
+            return None
+        top = self._chain_top(replica)
+        lease = (
+            top
+            if top is not None
+            else Lease(
+                replica=replica,
+                epoch=0,
+                stamp=0,
+                owner=replica,
+                journal_dir=journal_dir,
+            )
+        )
+        if not lease.journal_dir:
+            lease.journal_dir = journal_dir
+        return self._try_adopt(lease, stale_for_s)
+
+    def release_claim(self, replica: str, epoch: int) -> None:
+        """Hand back a claim this replica just won: the service calls
+        this when it finds itself fenced between the CAS win and the
+        replay — standing down while HOLDING the claim would strand
+        the orphan's runs forever (nothing re-polls an adopted chain).
+        Deleting the claim blob is safe exactly here: the CAS win made
+        this replica the blob's unique owner, and the chain GC has not
+        run yet, so the previous (stale, live) epoch becomes the top
+        again and a live survivor adopts it."""
+        self._storage.delete(_lease_key(replica, epoch))
+        with self._lock:
+            self._released.add((replica, epoch))
+        tm = get_telemetry()
+        tm.counter("service.fleet.claims_released").inc()
+        tm.event(
+            "fleet_claim_released",
+            replica=replica,
+            epoch=epoch,
+            holder=self.replica_id,
+        )
+
+    def _gc_chain(self, replica: str, keep_epoch: int) -> None:
+        """Drop superseded lease files of one chain (satellite: cap
+        fleet-dir growth — without this every heartbeat epoch bump and
+        adoption leaves a file behind forever)."""
+        removed = 0
+        for key in self._storage.list_keys(_chain_prefix(replica)):
+            lease = _parse_lease(self._storage.read_bytes(key))
+            if (
+                lease is not None
+                and lease.replica == replica
+                and lease.epoch < keep_epoch
+            ):
+                self._storage.delete(key)
+                removed += 1
+        if removed:
+            get_telemetry().counter("service.fleet.lease_gc").inc(removed)
+
+    # -- fleet poison ledger -------------------------------------------
+
+    def note_crash_loop(self, plan_key: str) -> int:
+        """Record that ``plan_key`` crash-looped THIS replica in the
+        shared breaker ledger; returns the distinct-replica count. The
+        per-process ``CircuitBreaker`` already stops local relaunches —
+        this composes it across hosts so an adopted poison run cannot
+        walk the fleet."""
+        key = _poison_key(plan_key)
+        for _ in range(16):
+            raw = self._storage.read_bytes(key)
+            try:
+                body = json.loads(raw) if raw is not None else {}
+            except Exception:  # noqa: BLE001 — torn ledger: rewrite
+                body = {}
+            replicas = sorted(
+                set(body.get("replicas", [])) | {self.replica_id}
+            )
+            new = json.dumps(
+                {"key": plan_key, "replicas": replicas}, sort_keys=True
+            ).encode()
+            if compare_and_swap(self.fleet_dir, key, raw, new):
+                get_telemetry().event(
+                    "fleet_crash_noted",
+                    plan_key=plan_key,
+                    replicas=replicas,
+                )
+                return len(replicas)
+        return len(self.crashed_replicas(plan_key))
+
+    def crashed_replicas(self, plan_key: str) -> List[str]:
+        raw = self._storage.read_bytes(_poison_key(plan_key))
+        try:
+            body = json.loads(raw) if raw is not None else {}
+        except Exception:  # noqa: BLE001
+            body = {}
+        return sorted(set(body.get("replicas", [])))
+
+    def quarantined(self, plan_key: str) -> bool:
+        """True once the key has crashed ``poison_replicas`` DISTINCT
+        replicas — the fleet-level analog of an open breaker."""
+        return len(self.crashed_replicas(plan_key)) >= self.poison_replicas
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        # lint-ok: thread-discipline: fleet-scoped heartbeat/watch loop
+        # owned by stop(); paced on Event.wait (injected-clock ages),
+        # never part of a scan
+        self._thread = threading.Thread(
+            target=self._loop,
+            daemon=True,
+            name=f"deequ-tpu-fleet-{self.replica_id}",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.heartbeat():
+                    # fenced: never watch or adopt again — a zombie
+                    # must not claim peer chains; the service notices
+                    # via epoch_fence_check on its next persist
+                    break
+                self.poll()
+            except Exception:  # noqa: BLE001 — storage hiccups must
+                pass  # not kill the heartbeat loop; next tick retries
+            self._stop.wait(self.heartbeat_s)
+
+    def stop(self, retire: bool = True) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=max(5.0, self.heartbeat_s * 2))
+        if retire:
+            self.retire()
+
+    # -- introspection --------------------------------------------------
+
+    def child_guard(self) -> str:
+        """The epoch guard shipped to isolated children via
+        ``engine/subproc.py`` (``CHILD_EPOCH_ENV``): enough for the
+        child to re-read the chain and discover a superseding epoch
+        without importing any service machinery."""
+        with self._lock:
+            epoch = self.epoch
+        return json.dumps(
+            {
+                "fleet_dir": self.fleet_dir,
+                "replica": self.replica_id,
+                "epoch": epoch,
+            },
+            sort_keys=True,
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``health()['fleet']`` payload: own lease, peer chains
+        with ages on this replica's clock, adoption/fence history."""
+        now = self._clock.now()
+        peers: Dict[str, Any] = {}
+        for chain_id, lease in self._chains().items():
+            if chain_id == self.replica_id:
+                continue
+            seen = self._peer_seen.get(chain_id)
+            peers[chain_id] = {
+                "epoch": lease.epoch,
+                "state": lease.state,
+                "owner": lease.owner,
+                "stale_for_s": (
+                    round(now - seen[1], 3) if seen is not None else None
+                ),
+            }
+        with self._lock:
+            adoptions = [
+                {
+                    "replica": a.replica,
+                    "epoch": a.epoch,
+                    "journal_dir": a.journal_dir,
+                    "stale_for_s": round(a.stale_for_s, 3),
+                }
+                for a in self._adoptions
+            ]
+            return {
+                "replica": self.replica_id,
+                "epoch": self.epoch,
+                "fenced": self._fenced,
+                "lease_timeout_s": self.lease_timeout_s,
+                "heartbeat_s": self.heartbeat_s,
+                "peers": peers,
+                "adoptions": adoptions,
+                "adoption_races_lost": self._races_lost,
+            }
+
+
+def epoch_fence_check(supervisor: Optional[FleetSupervisor]) -> bool:
+    """THE persist-path guard (fence-discipline staticcheck rule): True
+    when writing is allowed — no fleet configured, or this replica
+    still owns its lease epoch. On a fence hit it counts and logs the
+    suppressed write so zombie activity is visible on the health
+    plane, then returns False: the caller must drop the persist (the
+    adopter owns it now), not raise mid-flight."""
+    if supervisor is None:
+        return True
+    if not supervisor.fenced():
+        return True
+    tm = get_telemetry()
+    tm.counter("service.fleet.fenced_writes").inc()
+    tm.event(
+        "fleet_write_fenced",
+        replica=supervisor.replica_id,
+        epoch=supervisor.epoch,
+    )
+    return False

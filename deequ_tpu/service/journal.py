@@ -48,6 +48,22 @@ RECORD_TYPES = (
     "preempted",
     "resumed",
     "terminal",
+    # fleet failover (docs/SERVICE.md "Fleet failover"): an ``epoch``
+    # record marks an ownership transition of this journal directory —
+    # the replica that claimed it and under which lease epoch. Epoch
+    # records carry no run_id, so they are invisible to
+    # ``pending_runs()``; ``compact()`` keeps only the newest one (the
+    # older transitions are history, not state).
+    "epoch",
+    # adoption write-ahead bracket: an ``adoption_intent`` lands
+    # durably BEFORE this journal's owner CASes a claim on a dead
+    # peer's lease chain, ``adoption_done`` after the orphan's runs
+    # are all replayed (or the claim race was lost). An intent with no
+    # matching done is a half-finished adoption — whoever adopts (or
+    # recovers) THIS journal completes it via ``pending_adoptions()``,
+    # because the claimed chain itself is terminal and never re-polled.
+    "adoption_intent",
+    "adoption_done",
 )
 
 
@@ -143,6 +159,49 @@ class RunJournal:
     def record_terminal(self, run_id: str, state: str, **fields: Any) -> int:
         return self.append("terminal", run_id, state=state, **fields)
 
+    def record_epoch(
+        self, replica: str, epoch: int, **fields: Any
+    ) -> int:
+        """Mark an ownership transition of this journal directory: the
+        replica now holding it and under which lease epoch (written on
+        registration and again by an adopter after it wins the lease
+        CAS). Run-less on purpose: epoch records are provenance, not
+        run state."""
+        return self.append("epoch", "", replica=replica, epoch=int(epoch), **fields)
+
+    def record_adoption_intent(
+        self, replica: str, journal_dir: str, epoch: int, **fields: Any
+    ) -> int:
+        """Write-ahead of an adoption: this journal's owner is about
+        to claim ``replica``'s lease chain at ``epoch`` and replay the
+        journal at ``journal_dir``. Durable BEFORE the claim CAS, so a
+        claim can never outlive the knowledge of what it was for."""
+        return self.append(
+            "adoption_intent",
+            "",
+            replica=replica,
+            journal_dir=journal_dir,
+            epoch=int(epoch),
+            **fields,
+        )
+
+    def record_adoption_done(
+        self, replica: str, epoch: int, status: str = "adopted",
+        **fields: Any,
+    ) -> int:
+        """Close an adoption intent: the orphan's runs are all
+        journaled here (``status="adopted"``), the claim race was lost
+        (``"race_lost"``), or another replica finished it
+        (``"finished"``)."""
+        return self.append(
+            "adoption_done",
+            "",
+            replica=replica,
+            epoch=int(epoch),
+            status=status,
+            **fields,
+        )
+
     # -- replay side ------------------------------------------------------
 
     def replay(self) -> List[Dict[str, Any]]:
@@ -223,6 +282,28 @@ class RunJournal:
                     del pending[run_id]
         return pending
 
+    def pending_adoptions(self) -> List[Dict[str, Any]]:
+        """Adoption intents with no matching done record, in append
+        order — the half-finished adoptions a later adopter (or a
+        ``recover()`` of this journal) must complete. Keyed by
+        (orphan replica, claim epoch): a re-attempt of the same chain
+        claims a HIGHER epoch, so it is its own intent."""
+        intents: Dict[Any, Dict[str, Any]] = {}
+        for record in self.replay():
+            rtype = record.get("type")
+            if rtype not in ("adoption_intent", "adoption_done"):
+                continue
+            key = (record.get("replica"), record.get("epoch"))
+            if rtype == "adoption_intent":
+                intents[key] = {
+                    k: v
+                    for k, v in record.items()
+                    if k not in ("type", "seq", "run_id")
+                }
+            else:
+                intents.pop(key, None)
+        return list(intents.values())
+
     # -- maintenance ------------------------------------------------------
 
     def compact(self) -> int:
@@ -236,10 +317,37 @@ class RunJournal:
             for r in records
             if r.get("type") == "terminal" and r.get("run_id")
         }
+        # run-less epoch records would survive the terminal filter
+        # forever (their run_id "" is never terminal); keep only the
+        # newest — current ownership — and drop the history.
+        epoch_seqs = [
+            r["seq"]
+            for r in records
+            if r.get("type") == "epoch" and "seq" in r
+        ]
+        stale_epochs = set(epoch_seqs[:-1])
+        # adoption brackets: a done record closes its intent — both
+        # are history once matched. PENDING intents survive compaction
+        # (they are exactly the state a later adopter must replay).
+        done_keys = {
+            (r.get("replica"), r.get("epoch"))
+            for r in records
+            if r.get("type") == "adoption_done"
+        }
+        stale_adoptions = {
+            r["seq"]
+            for r in records
+            if r.get("type") in ("adoption_intent", "adoption_done")
+            and "seq" in r
+            and (r.get("replica"), r.get("epoch")) in done_keys
+        }
         live_seqs = {
             r["seq"]
             for r in records
-            if r.get("run_id") not in terminal and "seq" in r
+            if r.get("run_id") not in terminal
+            and "seq" in r
+            and r["seq"] not in stale_epochs
+            and r["seq"] not in stale_adoptions
         }
         removed = 0
         with self._lock:

@@ -80,9 +80,16 @@ class Scheduler:
             Callable[[RunTicket, Any], None]
         ] = None,
         on_resumed: Optional[Callable[[RunTicket], None]] = None,
+        fence: Optional[Callable[[], bool]] = None,
     ):
         self.queue = queue
         self.execute = execute
+        # fleet epoch fence (service/fleet.py): called before terminal
+        # handle transitions; False means this replica's lease epoch
+        # was superseded mid-run — the adopter owns these runs now, so
+        # their outcomes are DROPPED, not finished (finishing would
+        # fire on_terminal journal writes the zombie no longer owns)
+        self.fence = fence
         # superset-scan executor: takes the whole group, returns one
         # outcome PER MEMBER in order (a VerificationResult, or an
         # exception instance for a member that failed individually).
@@ -579,6 +586,23 @@ class Scheduler:
                 with self._state_lock:
                     self._busy -= 1
 
+    def _fenced_drop(self, group: List[RunTicket]) -> bool:
+        """True when the fence says this replica lost its epoch: log
+        the dropped group and let the caller skip every terminal
+        transition. The handles stay non-terminal on purpose — in this
+        process the runs have no true outcome; the adopter's copies
+        do."""
+        if self.fence is None or self.fence():
+            return False
+        from deequ_tpu.telemetry import get_telemetry
+
+        get_telemetry().event(
+            "scheduler_group_fenced",
+            run_ids=",".join(t.handle.run_id for t in group),
+            members=len(group),
+        )
+        return True
+
     def _serve_group(self, group: List[RunTicket]) -> None:
         lease = None
         record = None
@@ -605,13 +629,19 @@ class Scheduler:
         # (interrupts included) to result(); the worker thread
         # itself must survive any run
         except BaseException as exc:  # noqa: BLE001
-            for ticket in group:
-                if not self._requeue_preempted(ticket, exc):
-                    self._finish_failed(ticket, exc)
+            if self._fenced_drop(group):
+                pass
+            else:
+                for ticket in group:
+                    if not self._requeue_preempted(ticket, exc):
+                        self._finish_failed(ticket, exc)
         else:
-            for ticket, outcome in zip(group, outcomes):
-                if not self._requeue_preempted(ticket, outcome):
-                    self._finish_outcome(ticket, outcome)
+            if self._fenced_drop(group):
+                pass
+            else:
+                for ticket, outcome in zip(group, outcomes):
+                    if not self._requeue_preempted(ticket, outcome):
+                        self._finish_outcome(ticket, outcome)
         finally:
             if record is not None:
                 self.preemption.deregister(record)

@@ -51,6 +51,7 @@ from deequ_tpu.service.queue import (
     RunState,
     RunTicket,
 )
+from deequ_tpu.service.fleet import epoch_fence_check
 from deequ_tpu.service.preempt import run_cancel_token
 from deequ_tpu.service.scheduler import Scheduler
 from deequ_tpu.telemetry import get_telemetry
@@ -153,7 +154,12 @@ class VerificationService:
         preemption: Optional[bool] = None,
         autoscale: Optional[bool] = None,
         process_label: str = "",
+        fleet_dir: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        adopt_resolve: Optional[Callable[[Dict[str, Any]], Any]] = None,
     ):
+        import os
+
         from deequ_tpu import config
 
         opts = config.options()
@@ -202,6 +208,49 @@ class VerificationService:
         self._checkpoint_path: Optional[str] = (
             journal_dir.rstrip("/") + "/checkpoints" if journal_dir else None
         )
+        # fleet failover (docs/SERVICE.md "Fleet failover"): a shared
+        # fleet dir turns this replica into a fleet member — heartbeat
+        # lease, peer watch, orphan adoption, epoch fencing. Requires a
+        # journal (the journal IS what a peer adopts); checkpoints move
+        # to the SHARED fleet dir so an adopted run's durable cursors
+        # are readable by whichever replica resumes it.
+        fleet_dir = (
+            fleet_dir if fleet_dir is not None else opts.service_fleet_dir
+        )
+        self.fleet: Optional[Any] = None
+        self._adopt_resolve = adopt_resolve
+        self._adopted_handles: List[RunHandle] = []
+        #: journal dirs whose adoption replay is on the current call
+        #: stack — finishing a dead adopter's intents re-enters
+        #: ``_adopt_replica``, and a cyclic intent graph (two dead
+        #: adopters pointing at each other) must not recurse forever
+        self._adopting: set = set()
+        if fleet_dir and self.journal is not None:
+            from deequ_tpu.service.fleet import FleetSupervisor
+
+            self._checkpoint_path = (
+                fleet_dir.rstrip("/") + "/checkpoints"
+            )
+            replica = (
+                replica_id
+                or opts.service_fleet_replica
+                or f"replica-{os.getpid()}"
+            )
+            self.fleet = FleetSupervisor(
+                fleet_dir,
+                replica,
+                journal_dir=journal_dir,
+                clock=self.clock,
+                heartbeat_s=opts.service_fleet_heartbeat_s,
+                lease_timeout_s=opts.service_fleet_lease_timeout_s,
+                poison_replicas=opts.service_fleet_poison_replicas,
+                on_adopt=self._adopt_replica,
+                on_adopt_intent=self._journal_adopt_intent,
+                on_adopt_lost=self._journal_adopt_lost,
+            )
+            self.journal.record_epoch(
+                replica, self.fleet.epoch, reason="register"
+            )
         self.isolated = (
             bool(opts.isolated_execution) if isolated is None else bool(isolated)
         )
@@ -330,6 +379,9 @@ class VerificationService:
             preemption=self.preemption,
             on_preempted=self._journal_preempted,
             on_resumed=self._journal_resumed,
+            fence=(
+                self._scheduler_fence if self.fleet is not None else None
+            ),
         )
         # queue-driven autoscaling: the control loop over the per-class
         # queue-wait histograms and SLO burn (service/autoscale.py)
@@ -378,6 +430,8 @@ class VerificationService:
         self.scheduler.start()
         if self.autoscaler is not None:
             self.autoscaler.start()
+        if self.fleet is not None:
+            self.fleet.start()
         if self._metrics_port is not None and self.metrics_server is None:
             from deequ_tpu.telemetry import serve_metrics
 
@@ -417,6 +471,12 @@ class VerificationService:
         if self.autoscaler is not None:
             self.autoscaler.stop()
         self.scheduler.stop(timeout=timeout)
+        if self.fleet is not None:
+            # retire the lease only AFTER the scheduler drain: peers
+            # skip a retired chain, so retiring while runs are still
+            # in flight would forfeit failover coverage for exactly
+            # the crash-during-shutdown the journal otherwise survives
+            self.fleet.stop(retire=True)
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
@@ -474,6 +534,16 @@ class VerificationService:
         ordering is write-ahead: the submitted record lands durably
         BEFORE the ticket can be scheduled, so a crash between the two
         loses an unacknowledged submission, never an acknowledged one."""
+        if not epoch_fence_check(self.fleet):
+            # a fenced zombie must not ACCEPT work either: its journal
+            # now belongs to the adopter, so an admission here would be
+            # an unadoptable run
+            from deequ_tpu.service.fleet import FencedReplica
+
+            raise FencedReplica(
+                "this replica's lease epoch was superseded by an "
+                "adopter; restart the process to rejoin the fleet"
+            )
         handle = RunHandle(run_id, request.tenant, request.priority)
         budget = None
         if request.deadline_s is not None:
@@ -614,6 +684,8 @@ class VerificationService:
     def _journal_terminal(self, handle: RunHandle) -> None:
         if self.journal is None:
             return
+        if not epoch_fence_check(self.fleet):
+            return  # the adopter owns this run's journal now
         state, error = handle.terminal_info()
         if state is None:
             return
@@ -633,6 +705,8 @@ class VerificationService:
         the run as pending (and preempted) at recovery."""
         if self.journal is None:
             return
+        if not epoch_fence_check(self.fleet):
+            return
         self.journal.record_preempted(
             ticket.handle.run_id,
             reason=getattr(evidence, "reason", None),
@@ -643,6 +717,8 @@ class VerificationService:
 
     def _journal_resumed(self, ticket: RunTicket) -> None:
         if self.journal is None:
+            return
+        if not epoch_fence_check(self.fleet):
             return
         self.journal.record_resumed(
             ticket.handle.run_id, preemptions=int(ticket.preemptions)
@@ -670,6 +746,8 @@ class VerificationService:
         that already started resume mid-scan from their durable
         checkpoint cursors the moment they re-execute."""
         if self.journal is None:
+            return []
+        if not epoch_fence_check(self.fleet):
             return []
         tm = get_telemetry()
         pending = self.journal.pending_runs()
@@ -718,8 +796,252 @@ class VerificationService:
             )
         if recovered:
             tm.counter("service.runs_recovered").inc(len(recovered))
+        if self.fleet is not None:
+            # a restarted replica also finishes its own half-done
+            # adoptions: an intent with no done record means a claim
+            # CAS may have won without its replay completing — the
+            # claimed chain is terminal and never re-polled, so this
+            # is those runs' only road back
+            for intent in self.journal.pending_adoptions():
+                self._finish_adoption(self.journal, intent)
         self.journal.compact()
         return recovered
+
+    # -- fleet adoption --------------------------------------------------
+
+    def _scheduler_fence(self) -> bool:
+        """Scheduler hook: True while this replica may finish runs."""
+        return epoch_fence_check(self.fleet)
+
+    def _journal_adopt_intent(self, adoption: Any) -> None:
+        """FleetSupervisor ``on_adopt_intent`` hook, fired BEFORE the
+        claim CAS: durably record in OUR journal which chain we are
+        about to claim and where its journal lives. A claimed chain is
+        terminal — nothing re-polls it — so without this write-ahead
+        an adopter dying between the CAS win and the replay would
+        strand the orphan's runs forever; with it, whoever adopts (or
+        recovers) THIS journal finds the intent and finishes the
+        adoption. Raising aborts the claim."""
+        if not epoch_fence_check(self.fleet):
+            from deequ_tpu.service.fleet import FencedReplica
+
+            raise FencedReplica(
+                "fenced: this replica must not claim peer chains"
+            )
+        self.journal.record_adoption_intent(
+            adoption.replica, adoption.journal_dir, adoption.epoch
+        )
+
+    def _journal_adopt_lost(self, adoption: Any) -> None:
+        """FleetSupervisor ``on_adopt_lost`` hook: another survivor
+        won the claim CAS — close our intent so nobody replays a race
+        we lost."""
+        if not epoch_fence_check(self.fleet):
+            return
+        self.journal.record_adoption_done(
+            adoption.replica, adoption.epoch, status="race_lost"
+        )
+
+    def _adopt_replica(self, adoption: Any) -> List[RunHandle]:
+        """FleetSupervisor callback after WINNING the lease CAS on a
+        dead peer's chain: replay the orphan journal's pending runs
+        into OUR queue through the recover() resolve contract
+        (``adopt_resolve(entry) -> RunRequest | None``).
+
+        Ordering per run: (1) write-ahead ``submitted`` record in OUR
+        journal under a fresh run id carrying ``adopted_from``, (2)
+        admit, (3) mark the run ``adopted`` (terminal) in the ORPHAN
+        journal. The whole replay runs under the adoption intent this
+        replica journaled before the CAS (``_journal_adopt_intent``)
+        and is closed by an ``adoption_done`` record at the end — an
+        adopter dying ANYWHERE in between leaves a pending intent that
+        its own adopter (or its restarted self, via ``recover()``)
+        finishes: at-least-once across a double failure, exactly-once
+        otherwise (the fence keeps the zombie original from ever
+        double-persisting). A replica that finds itself fenced after
+        the CAS win hands the claim back (``release_claim``) so the
+        chain stays adoptable by a live survivor.
+
+        Started runs resume from their durable cursors automatically:
+        checkpoints live under the SHARED fleet dir keyed by plan
+        token, not by replica or run id."""
+        if not epoch_fence_check(self.fleet):
+            self.fleet.release_claim(adoption.replica, adoption.epoch)
+            return []
+        if (
+            adoption.journal_dir in self._adopting
+            or adoption.journal_dir == self.journal.path
+        ):
+            # cyclic intent graph (dead adopters pointing at each
+            # other) or a self-claim: nothing to replay that is not
+            # already being replayed higher up this call stack
+            self.fleet.release_claim(adoption.replica, adoption.epoch)
+            return []
+        self._adopting.add(adoption.journal_dir)
+        try:
+            return self._replay_orphan(adoption)
+        finally:
+            self._adopting.discard(adoption.journal_dir)
+
+    def _replay_orphan(self, adoption: Any) -> List[RunHandle]:
+        """The adoption replay body (see ``_adopt_replica`` for the
+        ordering contract; the caller holds the re-entrancy guard and
+        has already passed the epoch fence)."""
+        if not epoch_fence_check(self.fleet):
+            self.fleet.release_claim(adoption.replica, adoption.epoch)
+            return []
+        tm = get_telemetry()
+        from deequ_tpu.service.journal import RunJournal as _Journal
+
+        orphan = _Journal(adoption.journal_dir)
+        orphan.record_epoch(
+            self.fleet.replica_id,
+            adoption.epoch,
+            reason="adopted",
+            stale_for_s=round(adoption.stale_for_s, 3),
+        )
+        adopted: List[RunHandle] = []
+        for run_id, entry in orphan.pending_runs().items():
+            # same key shape the isolated runner's breaker (and the
+            # crash-loop ledger writes above) use
+            plan_key = (
+                f"dataset:{entry['dataset_key']}"
+                if entry.get("dataset_key")
+                else run_id
+            )
+            if self.fleet.quarantined(plan_key):
+                # poison: this run already crashed enough DISTINCT
+                # replicas — quarantine instead of walking the fleet
+                tm.counter("service.fleet.poisoned_runs").inc()
+                tm.event(
+                    "fleet_run_poisoned",
+                    run_id=run_id,
+                    plan_key=plan_key,
+                    replicas=self.fleet.crashed_replicas(plan_key),
+                )
+                orphan.record_terminal(
+                    run_id,
+                    RunState.FAILED,
+                    error=(
+                        "fleet poison quarantine: crashed "
+                        f"{len(self.fleet.crashed_replicas(plan_key))} "
+                        "distinct replicas"
+                    ),
+                )
+                continue
+            request = (
+                self._adopt_resolve(entry)
+                if self._adopt_resolve is not None
+                else None
+            )
+            if request is None:
+                orphan.record_terminal(
+                    run_id,
+                    RunState.FAILED,
+                    error="unresolvable at adoption (no RunRequest)",
+                )
+                tm.event(
+                    "service_run_unrecoverable",
+                    run_id=run_id,
+                    tenant=entry.get("tenant"),
+                )
+                continue
+            if entry.get("priority") is not None:
+                request.priority = int(entry["priority"])
+            if entry.get("deadline_s") is not None:
+                request.deadline_s = float(entry["deadline_s"])
+            with self._handles_lock:
+                self._run_seq += 1
+                new_id = f"run-{self._run_seq}"
+            self.journal.record_submitted(
+                new_id,
+                tenant=request.tenant,
+                priority=int(request.priority),
+                deadline_s=request.deadline_s,
+                dataset_key=request.dataset_key,
+                adopted_from=run_id,
+                adopted_replica=adoption.replica,
+            )
+            handle = self._admit(request, new_id, journal=False)
+            adopted.append(handle)
+            orphan.record_terminal(
+                run_id,
+                "adopted",
+                adopted_as=new_id,
+                adopter=self.fleet.replica_id,
+            )
+            tm.counter("service.fleet.runs_adopted").inc()
+            tm.event(
+                "service_run_adopted",
+                run_id=new_id,
+                adopted_from=run_id,
+                replica=adoption.replica,
+                tenant=entry.get("tenant"),
+                started=bool(entry.get("started")),
+                last_checkpoint=entry.get("last_checkpoint"),
+            )
+        # finish the DEAD replica's own half-done adoptions: its
+        # journal may hold intents with no done record — chains it
+        # claimed whose replay never completed. Those chains are
+        # terminally "adopted" and never re-polled, so this replay is
+        # their runs' only road back.
+        for intent in orphan.pending_adoptions():
+            self._finish_adoption(orphan, intent)
+        # close OUR intent for this chain: the replay is complete, a
+        # later adopter of this journal has nothing left to finish
+        self.journal.record_adoption_done(
+            adoption.replica,
+            adoption.epoch,
+            status="adopted",
+            runs=len(adopted),
+        )
+        # journal hygiene: the orphan log is now all-terminal — shrink
+        # it (and our own) so the next scan is O(live runs)
+        orphan.compact()
+        self.journal.compact()
+        with self._handles_lock:
+            self._adopted_handles.extend(adopted)
+        return adopted
+
+    def _finish_adoption(
+        self, journal: Any, intent: Dict[str, Any]
+    ) -> None:
+        """Complete a half-done adoption found in ``journal`` (ours at
+        ``recover()``, a dead adopter's during replay): re-claim the
+        nested orphan chain at ITS next epoch — the claim CAS keeps
+        finishers unique however many replicas walk the same intent
+        chain — and replay whatever runs are still pending in that
+        journal (runs the dead adopter already re-admitted are
+        terminal there and stay put). The intent is then closed in the
+        journal that held it, which this replica now owns."""
+        if not epoch_fence_check(self.fleet):
+            return
+        replica = str(intent.get("replica") or "")
+        journal_dir = str(intent.get("journal_dir") or "")
+        if not replica or not journal_dir:
+            return
+        if (
+            replica != self.fleet.replica_id
+            and journal_dir != self.journal.path
+            and journal_dir not in self._adopting
+        ):
+            # re-claiming fires the full adoption cycle: our own
+            # intent lands first, then the CAS, then _adopt_replica
+            if self.fleet.adopt_chain(replica, journal_dir) is not None:
+                get_telemetry().counter(
+                    "service.fleet.adoptions_finished"
+                ).inc()
+        journal.record_adoption_done(
+            replica,
+            int(intent.get("epoch") or 0),
+            status="finished",
+            finisher=self.fleet.replica_id,
+        )
+
+    def adopted_runs(self) -> List[RunHandle]:
+        """Handles of every run this replica adopted from dead peers."""
+        with self._handles_lock:
+            return list(self._adopted_handles)
 
     def handle(self, run_id: str) -> Optional[RunHandle]:
         with self._handles_lock:
@@ -771,7 +1093,8 @@ class VerificationService:
         kwargs: Dict[str, Any] = {}
         if self._checkpoint_path is not None:
             kwargs["checkpointer"] = _JournalingCheckpointer(
-                self._checkpoint_path, self.journal, run_id
+                self._checkpoint_path, self.journal, run_id,
+                fleet=self.fleet,
             )
         if mesh is not None:
             kwargs["mesh"] = mesh
@@ -779,7 +1102,7 @@ class VerificationService:
 
     def _execute(self, ticket: RunTicket):
         request: RunRequest = ticket.payload
-        if self.journal is not None:
+        if self.journal is not None and epoch_fence_check(self.fleet):
             self.journal.record_started(
                 ticket.handle.run_id, tenant=request.tenant
             )
@@ -843,7 +1166,10 @@ class VerificationService:
             and request.result_key is not None
         ):
             _persist_slo_records(
-                request.metrics_repository, request.result_key, self.slo
+                request.metrics_repository,
+                request.result_key,
+                self.slo,
+                fleet=self.fleet,
             )
         return result
 
@@ -910,11 +1236,21 @@ class VerificationService:
             # as ONE control message; the child exits cleanly through
             # its checkpoint path — never terminated mid-batch
             cancel_token=run_cancel_token(ticket),
+            epoch_guard=(
+                self.fleet.child_guard() if self.fleet is not None else None
+            ),
         )
         try:
             result = runner.run(_isolated_execute, payload)
         except CrashLoopError as exc:
             self._note_crash()
+            if self.fleet is not None:
+                # shared breaker ledger: a crash loop HERE becomes
+                # fleet-visible, so the run cannot walk the fleet via
+                # adoption once poison_replicas distinct hosts crashed
+                self.fleet.note_crash_loop(
+                    f"dataset:{request.dataset_key}"
+                )
             from deequ_tpu import config
 
             policy = config.options().degradation_policy
@@ -947,7 +1283,7 @@ class VerificationService:
         tm = get_telemetry()
         host = tickets[0]
         run_ids = [t.handle.run_id for t in tickets]
-        if self.journal is not None:
+        if self.journal is not None and epoch_fence_check(self.fleet):
             for ticket in tickets:
                 self.journal.record_started(
                     ticket.handle.run_id, tenant=ticket.payload.tenant
@@ -1038,6 +1374,7 @@ class VerificationService:
                     member.result_key,
                     result,
                     slo=self.slo,
+                    fleet=self.fleet,
                 )
         self.plans.record_run(getattr(results[0], "telemetry", None))
         return list(results)
@@ -1122,11 +1459,18 @@ class VerificationService:
                 else None
             ),
             clock=self.clock,
+            epoch_guard=(
+                self.fleet.child_guard() if self.fleet is not None else None
+            ),
         )
         try:
             results = runner.run(_isolated_execute_coalesced, payload)
         except CrashLoopError as exc:
             self._note_crash()
+            if self.fleet is not None:
+                self.fleet.note_crash_loop(
+                    f"dataset:{request.dataset_key}"
+                )
             from deequ_tpu import config
 
             policy = config.options().degradation_policy
@@ -1156,6 +1500,7 @@ class VerificationService:
                 member.result_key,
                 result,
                 slo=self.slo,
+                fleet=self.fleet,
             )
         if results and not isinstance(results[0], Exception):
             self.plans.record_run(getattr(results[0], "telemetry", None))
@@ -1171,6 +1516,8 @@ class VerificationService:
         }
         if self.placer is not None:
             snap["placement"] = self.placer.snapshot()
+        if self.fleet is not None:
+            snap["fleet"] = self.fleet.snapshot()
         return snap
 
     def health(self) -> Dict[str, Any]:
@@ -1222,6 +1569,18 @@ class VerificationService:
             payload["autoscale"] = self.autoscaler.snapshot()
         if self.slo is not None:
             payload["slo"] = self.slo.snapshot()
+        if self.fleet is not None:
+            fleet = self.fleet.snapshot()
+            fleet["fenced_writes"] = counters.get(
+                "service.fleet.fenced_writes", 0
+            )
+            fleet["runs_adopted"] = counters.get(
+                "service.fleet.runs_adopted", 0
+            )
+            fleet["poisoned_runs"] = counters.get(
+                "service.fleet.poisoned_runs", 0
+            )
+            payload["fleet"] = fleet
         return payload
 
 
@@ -1237,12 +1596,18 @@ class _JournalingCheckpointer(ScanCheckpointer):
         journal: Optional[RunJournal],
         run_id: str,
         every_batches: Optional[int] = None,
+        fleet: Optional[Any] = None,
     ):
         super().__init__(path, every_batches)
         self._journal = journal
         self._run_id = run_id
+        self._fleet = fleet
 
     def save(self, cursor, plan_token, states, host_accs, degradation):
+        if not epoch_fence_check(self._fleet):
+            # fenced mid-run: the adopter's resumed copy owns the
+            # cursor now — a zombie save here could rewind it
+            return
         super().save(cursor, plan_token, states, host_accs, degradation)
         if self._journal is not None:
             self._journal.record_checkpoint(
@@ -1251,6 +1616,26 @@ class _JournalingCheckpointer(ScanCheckpointer):
                 row_offset=int(cursor.row_offset),
                 plan_token=plan_token,
             )
+
+
+class _EpochFencedCheckpointer(ScanCheckpointer):
+    """Child-side checkpointer: before every save, re-read the lease
+    chain named by the shipped epoch guard (``CHILD_EPOCH_ENV``,
+    engine/subproc.py) — a child whose PARENT was fenced while the
+    child kept scanning must also stop persisting cursors, or the
+    zombie pair would rewind the adopter's progress. The guard check
+    is a couple of small reads per checkpoint interval, not per
+    batch."""
+
+    def save(self, cursor, plan_token, states, host_accs, degradation):
+        from deequ_tpu.engine.subproc import child_epoch_fenced
+
+        if child_epoch_fenced():
+            get_telemetry().counter(
+                "service.fleet.child_checkpoint_drops"
+            ).inc()
+            return
+        super().save(cursor, plan_token, states, host_accs, degradation)
 
 
 def _child_engine(payload: Dict[str, Any]):
@@ -1262,7 +1647,7 @@ def _child_engine(payload: Dict[str, Any]):
     same shape-keyed plan entry its warmup compiled)."""
     kwargs: Dict[str, Any] = {}
     if payload.get("checkpoint_path"):
-        kwargs["checkpointer"] = ScanCheckpointer(
+        kwargs["checkpointer"] = _EpochFencedCheckpointer(
             payload["checkpoint_path"]
         )
     ndev = payload.get("placement_ndev")
@@ -1339,7 +1724,9 @@ def _isolated_execute_coalesced(payload: Dict[str, Any]) -> List[Any]:
     return results
 
 
-def _persist_member_result(repository, key, result, slo=None) -> None:
+def _persist_member_result(
+    repository, key, result, slo=None, fleet=None
+) -> None:
     """Append one coalesced member's sliced result to its metrics
     repository — the same load/combine/save (with operational records)
     that ``do_analysis_run`` performs for a solo run. The coalesced
@@ -1347,6 +1734,8 @@ def _persist_member_result(repository, key, result, slo=None) -> None:
     owns a DIFFERENT repository/key pair and only its own slice. When
     the service tracks SLOs, the current attainment snapshot rides
     along as ``slo.*`` operational records under the same key."""
+    if not epoch_fence_check(fleet):
+        return  # fenced: the adopter persists this member's result
     from deequ_tpu.analyzers.runner import AnalyzerContext
     from deequ_tpu.repository.base import AnalysisResult
 
@@ -1379,11 +1768,13 @@ def _persist_member_result(repository, key, result, slo=None) -> None:
     repository.save(AnalysisResult(key, combined))
 
 
-def _persist_slo_records(repository, key, slo) -> None:
+def _persist_slo_records(repository, key, slo, fleet=None) -> None:
     """Append the service's current SLO attainment snapshot as
     operational records under a run's ``ResultKey`` — error-budget
     burn becomes one more metric series the existing anomaly
     strategies can trend, with zero new query machinery."""
+    if not epoch_fence_check(fleet):
+        return
     from deequ_tpu.analyzers.runner import AnalyzerContext
     from deequ_tpu.repository.base import AnalysisResult
     from deequ_tpu.telemetry.oprecords import slo_metrics

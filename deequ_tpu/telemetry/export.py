@@ -112,6 +112,10 @@ def serve_metrics(
     - ``GET /healthz`` — ``health()`` rendered as JSON (queue depths,
       slices active, breaker states, shed counts when wired by
       ``VerificationService``); ``{"status": "ok"}`` if no callback
+    - ``GET /fleetz`` — the health payload's ``fleet`` section alone
+      (lease epoch, peer ages, adoptions, fenced writes — docs/
+      SERVICE.md "Fleet failover"); ``{"status": "no fleet"}`` when
+      the replica is not a fleet member
 
     ``port=0`` binds an ephemeral port (read it off the returned
     handle). The caller owns shutdown via ``MetricsServer.close()``.
@@ -135,6 +139,15 @@ def serve_metrics(
                     }
                 except Exception as exc:  # noqa: BLE001 — a broken
                     # health probe must report, not 500-and-hide
+                    payload = {"status": "error", "error": str(exc)}
+                body = json.dumps(payload, default=str).encode("utf-8")
+                ctype = "application/json"
+            elif self.path.split("?", 1)[0] == "/fleetz":
+                try:
+                    full = health() if health is not None else {}
+                    payload = full.get("fleet") or {"status": "no fleet"}
+                except Exception as exc:  # noqa: BLE001 — same
+                    # report-don't-hide contract as /healthz
                     payload = {"status": "error", "error": str(exc)}
                 body = json.dumps(payload, default=str).encode("utf-8")
                 ctype = "application/json"

@@ -22,6 +22,16 @@ single process and asserts the fleet's metrics match.
 
     python examples/distributed_service.py
 
+``--failover`` runs the OTHER distributed story instead — fleet
+failover (docs/SERVICE.md "Fleet failover"): two plain service
+replicas share a fleet directory, the victim is SIGKILLed at 50%
+queue progress, and the survivor's heartbeat watch adopts its
+journal, resumes the mid-flight run from the shared durable
+checkpoint cursor, and finishes the backlog. That mode needs no
+cross-process collectives and runs on plain CPU:
+
+    python examples/distributed_service.py --failover
+
 NOTE: like examples/multihost_grouping.py, the cross-process
 collective scan needs a real multi-host backend; under
 ``JAX_PLATFORMS=cpu`` the CPU backend has no cross-host collective
@@ -143,12 +153,153 @@ print(f"worker {pid} done", flush=True)
 """.replace("_SUITE_SRC", SUITE_SRC).replace("N_SUITES", str(N_SUITES))
 
 
-def main() -> None:
+#: the fleet-failover demo's victim replica: a whole service process —
+#: heartbeat lease, journaled runs, shared-dir checkpoints — that the
+#: parent SIGKILLs mid-queue (docs/SERVICE.md "Fleet failover"). Runs
+#: on any backend, including plain CPU: failover needs only the shared
+#: fleet directory, not cross-process collectives.
+FAILOVER_VICTIM = r"""
+import sys
+fleet_dir, journal_dir, rows, n_runs = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+)
+import numpy as np
+from deequ_tpu import Check, CheckLevel, Dataset, config
+from deequ_tpu.service import Priority, RunRequest, VerificationService
+
+rng = np.random.default_rng(17)
+data = {"a": rng.normal(size=rows).tolist()}
+checks = [
+    Check(CheckLevel.ERROR, "failover").has_size(lambda s: s == rows)
+    .is_complete("a")
+]
+with config.configure(
+    checkpoint_every_batches=4, batch_size=max(4096, rows // 32),
+    device_cache_bytes=0,
+    service_fleet_heartbeat_s=0.3, service_fleet_lease_timeout_s=1.2,
+):
+    svc = VerificationService(
+        workers=1, isolated=False, journal_dir=journal_dir,
+        fleet_dir=fleet_dir, replica_id="replica-victim",
+    ).start()
+    handles = [
+        svc.submit(RunRequest(
+            tenant="demo", checks=checks,
+            dataset_key=f"demo-{i}",
+            dataset_factory=lambda: Dataset.from_pydict(data),
+            priority=Priority.STANDARD,
+        ))
+        for i in range(n_runs)
+    ]
+    for i, h in enumerate(handles):
+        h.wait(timeout=600)
+        print(f"DONE {i}", flush=True)
+"""
+
+
+def _run_failover(workdir: str, rows: int = 200_000, n_runs: int = 4):
+    """Fleet failover over loopback: SIGKILL a replica at 50% queue
+    progress; the survivor adopts its journal off the shared fleet dir
+    and finishes the backlog, resuming the mid-flight run from its
+    durable checkpoint cursor."""
+    import signal
+    import time
+
+    import numpy as np
+
+    from deequ_tpu import Check, CheckLevel, Dataset, config
+    from deequ_tpu.service import RunRequest, RunState, VerificationService
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    victim_journal = os.path.join(workdir, "victim-journal")
+    survivor_journal = os.path.join(workdir, "survivor-journal")
+    rng = np.random.default_rng(17)  # the victim builds the SAME table
+    data = {"a": rng.normal(size=rows).tolist()}
+    checks = [
+        Check(CheckLevel.ERROR, "failover")
+        .has_size(lambda s, rows=rows: s == rows)
+        .is_complete("a")
+    ]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c", FAILOVER_VICTIM,
+            fleet_dir, victim_journal, str(rows), str(n_runs),
+        ],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        for line in proc.stdout:
+            print(f"victim: {line.strip()}", flush=True)
+            if line.strip() == f"DONE {n_runs // 2 - 1}":
+                os.kill(proc.pid, signal.SIGKILL)  # mid-queue, no warning
+                break
+    finally:
+        if proc.poll() is None and proc.returncode is None:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        proc.stdout.close()
+    print("victim SIGKILLed at 50% queue progress", flush=True)
+
+    with config.configure(
+        checkpoint_every_batches=4, batch_size=max(4096, rows // 32),
+        device_cache_bytes=0,
+        service_fleet_heartbeat_s=0.3, service_fleet_lease_timeout_s=1.2,
+    ):
+        svc = VerificationService(
+            workers=1, isolated=False, journal_dir=survivor_journal,
+            fleet_dir=fleet_dir, replica_id="replica-survivor",
+            adopt_resolve=lambda entry: RunRequest(
+                tenant=entry["tenant"],
+                checks=checks,
+                dataset_key=entry.get("dataset_key"),
+                dataset_factory=lambda: Dataset.from_pydict(data),
+            ),
+        ).start()
+        try:
+            deadline = time.monotonic() + 30
+            while not svc.adopted_runs() and time.monotonic() < deadline:
+                time.sleep(0.1)  # the supervisor thread polls for us
+            adopted = svc.adopted_runs()
+            assert adopted, "survivor never adopted the victim's journal"
+            snap = svc.health()["fleet"]
+            print(
+                f"survivor adopted {len(adopted)} run(s) from "
+                f"{snap['adoptions'][0]['replica']} after "
+                f"{snap['adoptions'][0]['stale_for_s']}s stale",
+                flush=True,
+            )
+            for h in adopted:
+                assert h.wait(timeout=300), h.run_id
+                assert h.status == RunState.DONE, (h.run_id, h.status)
+                print(
+                    f"adopted {h.run_id}: {h.result(timeout=0).status}",
+                    flush=True,
+                )
+        finally:
+            svc.stop(drain=False, timeout=30)
+    print(
+        f"fleet failover (loopback, shared fleet dir): {len(adopted)} "
+        "orphan run(s) adopted and finished, zero lost",
+        flush=True,
+    )
+
+
+def main(argv=None) -> None:
     import shutil
 
+    argv = sys.argv[1:] if argv is None else argv
     workdir = tempfile.mkdtemp(prefix="deequ_tpu_dist_svc_")
     try:
-        _run(workdir)
+        if "--failover" in argv:
+            _run_failover(workdir)
+        else:
+            _run(workdir)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

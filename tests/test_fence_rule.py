@@ -1,0 +1,189 @@
+"""fence-discipline staticcheck rule (tools/staticcheck/fence.py).
+
+Fixture pattern matches tests/test_staticcheck.py: every behavior is
+pinned by a PLANTED violation the analyzer must catch plus its
+corrected twin it must stay silent on. The whole-repo cleanliness gate
+lives in test_staticcheck's ``TestRepoGate`` — these tests only pin
+the rule's own detection logic.
+"""
+
+import os
+import textwrap
+
+from tools.staticcheck import run_analyzers, unwaived
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, rel, source):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return rel
+
+
+def _fence_findings(tmp_path):
+    findings = unwaived(run_analyzers(str(tmp_path)))
+    return [f for f in findings if f.rule == "fence-discipline"]
+
+
+UNFENCED_TERMINAL = """
+    def finish(self, handle):
+        state, error = handle.terminal_info()
+        self.journal.record_terminal(handle.run_id, state)
+"""
+
+FENCED_TERMINAL = """
+    def finish(self, handle):
+        if not epoch_fence_check(self.fleet):
+            return
+        state, error = handle.terminal_info()
+        self.journal.record_terminal(handle.run_id, state)
+"""
+
+
+class TestFenceDiscipline:
+    def test_catches_unfenced_journal_persist(self, tmp_path):
+        _write(
+            tmp_path, "deequ_tpu/service/fixture.py", UNFENCED_TERMINAL
+        )
+        findings = _fence_findings(tmp_path)
+        assert len(findings) == 1
+        assert findings[0].symbol == "record_terminal"
+        assert "epoch_fence_check" in findings[0].message
+
+    def test_silent_on_fenced_twin(self, tmp_path):
+        _write(
+            tmp_path, "deequ_tpu/service/fixture.py", FENCED_TERMINAL
+        )
+        assert _fence_findings(tmp_path) == []
+
+    def test_catches_unfenced_repository_save(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def persist(repository, key, result, fleet=None):
+                repository.save(result)
+            """,
+        )
+        findings = _fence_findings(tmp_path)
+        assert len(findings) == 1
+        assert findings[0].symbol == "save"
+
+    def test_fence_must_precede_lexically(self, tmp_path):
+        """A fence check AFTER the persist does not license it — the
+        ordering is the invariant, not mere presence."""
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def finish(self, handle):
+                self.journal.record_terminal(handle.run_id, "done")
+                if not epoch_fence_check(self.fleet):
+                    return
+            """,
+        )
+        assert len(_fence_findings(tmp_path)) == 1
+
+    def test_each_function_needs_its_own_fence(self, tmp_path):
+        """A fence in one function does not cover a persist in a
+        sibling — every scope establishes its own (the fence is sticky
+        per check, not per module)."""
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def fenced(self, handle):
+                if not epoch_fence_check(self.fleet):
+                    return
+                self.journal.record_started(handle.run_id)
+
+            def unfenced(self, handle):
+                self.journal.record_started(handle.run_id)
+            """,
+        )
+        findings = _fence_findings(tmp_path)
+        assert len(findings) == 1
+        assert "unfenced" in findings[0].message
+
+    def test_every_guarded_record_attr_is_covered(self, tmp_path):
+        guarded = (
+            "record_submitted",
+            "record_started",
+            "record_checkpoint",
+            "record_preempted",
+            "record_resumed",
+            "record_terminal",
+        )
+        body = "\n".join(
+            f"    journal.{attr}('run-1')" for attr in guarded
+        )
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            f"def persist_all(journal):\n{body}\n",
+        )
+        findings = _fence_findings(tmp_path)
+        assert sorted(f.symbol for f in findings) == sorted(guarded)
+
+    def test_super_save_definitions_are_exempt(self, tmp_path):
+        """``super().save(...)`` has a computed callee (the func value
+        is a Call), so checkpointer subclass DEFINITIONS that fence
+        inside save() do not flag."""
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            class Fenced(Base):
+                def save(self, cursor):
+                    if child_epoch_fenced():
+                        return
+                    super().save(cursor)
+            """,
+        )
+        assert _fence_findings(tmp_path) == []
+
+    def test_out_of_scope_dirs_are_untouched(self, tmp_path):
+        """The rule scopes to deequ_tpu/service/ — engine code calling
+        .save() (checkpointers themselves) is not service persist
+        discipline."""
+        _write(
+            tmp_path,
+            "deequ_tpu/engine/fixture.py",
+            UNFENCED_TERMINAL,
+        )
+        assert _fence_findings(tmp_path) == []
+
+    def test_journal_module_itself_is_exempt(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/journal.py",
+            """
+            class RunJournal:
+                def record_terminal(self, run_id, state):
+                    return self.append("terminal", run_id, state=state)
+
+                def helper(self):
+                    self.record_terminal("r", "done")
+            """,
+        )
+        assert _fence_findings(tmp_path) == []
+
+    def test_waiver_suppresses_with_reason(self, tmp_path):
+        _write(
+            tmp_path,
+            "deequ_tpu/service/fixture.py",
+            """
+            def adopt(self, journal, run_id):
+                # lint-ok: fence-discipline: the lease CAS win one
+                # line above IS the fence for this write
+                journal.record_terminal(run_id, "adopted")
+            """,
+        )
+        assert _fence_findings(tmp_path) == []
+
+    def test_rule_registered_in_default_suite(self):
+        from tools.staticcheck import all_rules
+
+        assert "fence-discipline" in [rule for rule, _ in all_rules()]

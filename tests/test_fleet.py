@@ -1,0 +1,831 @@
+"""Fleet failover (docs/SERVICE.md "Fleet failover"): heartbeat
+leases, orphan-run adoption, and epoch fencing for the distributed
+service (service/fleet.py + the service/scheduler/subproc wiring).
+
+Three layers of evidence here:
+
+- ``FleetSupervisor`` units on a ``ManualClock``: lease-chain
+  registration, heartbeat renewal, staleness-driven adoption, the
+  CAS exactly-one-adopter guarantee, chain GC, retirement, the chain
+  prefix-collision trap, and the fleet poison ledger.
+- In-process two-replica services: a zombie replica (its chain
+  adopted while it was paused) must refuse admission with
+  ``FencedReplica`` and silently drop every journal/repository
+  persist — ZERO bytes of journal growth, zero repository saves —
+  while the adopter re-admits its pending runs exactly once.
+- The chaos differential: a whole replica process (service + fleet
+  supervisor + a mid-scan run with durable checkpoints) dies by REAL
+  SIGKILL; a surviving replica adopts its journal within one poll of
+  lease expiry, resumes the run from the shared durable cursor, and
+  finishes BIT-IDENTICAL to an uninterrupted oracle run.
+
+Child functions are module-level (spawn pickles by reference); the
+autouse reap fixture asserts no zombie children leak.
+"""
+
+import json
+import multiprocessing
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu import config
+from deequ_tpu.analyzers import (
+    ApproxQuantile,
+    Completeness,
+    Mean,
+    Size,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.engine.deadline import ManualClock
+from deequ_tpu.engine.subproc import (
+    CHILD_EPOCH_ENV,
+    CrashLoopError,
+    IsolatedRunner,
+    child_epoch_fenced,
+    reset_breakers,
+)
+from deequ_tpu.service import (
+    Priority,
+    RunRequest,
+    RunState,
+    VerificationService,
+)
+from deequ_tpu.service import service as service_module
+from deequ_tpu.service.fleet import (
+    FencedReplica,
+    FleetSupervisor,
+    Lease,
+    _lease_key,
+    epoch_fence_check,
+)
+from deequ_tpu.service.journal import RunJournal
+from deequ_tpu.telemetry import get_telemetry
+from deequ_tpu.verification.suite import VerificationSuite
+
+
+@pytest.fixture(autouse=True)
+def _reaped_and_reset():
+    reset_breakers()
+    yield
+    assert multiprocessing.active_children() == []
+    reset_breakers()
+
+
+def _table_data(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.normal(size=n).tolist(),
+        "g": (np.arange(n) % 7).tolist(),
+    }
+
+
+def _checks(n=1000):
+    return [
+        Check(CheckLevel.ERROR, "fleet-failover")
+        .has_size(lambda s, n=n: s == n)
+        .is_complete("a")
+    ]
+
+
+def _result_values(result):
+    out = []
+    for analyzer, metric in result.metrics.items():
+        assert metric.value.is_success, (analyzer, metric.value)
+        out.append((str(analyzer), metric.value.get()))
+    return sorted(out)
+
+
+def _counter(name):
+    return get_telemetry().counter(name).value
+
+
+class _FakeResult:
+    status = CheckStatus.SUCCESS
+    metrics = {}
+
+
+# --------------------------------------------------------------------------
+# FleetSupervisor units (ManualClock, hand-driven heartbeat/poll)
+# --------------------------------------------------------------------------
+
+
+class TestFleetSupervisor:
+    def _sup(self, tmp_path, clk, replica, **kw):
+        kw.setdefault("heartbeat_s", 1.0)
+        kw.setdefault("lease_timeout_s", 5.0)
+        return FleetSupervisor(
+            str(tmp_path / "fleet"),
+            replica,
+            journal_dir=str(tmp_path / f"journal-{replica}"),
+            clock=clk,
+            **kw,
+        )
+
+    def test_register_heartbeat_and_zombie_twin_fencing(self, tmp_path):
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        assert a.epoch == 1
+        assert a.heartbeat() is True
+        assert a.fenced() is False
+        # a twin re-registering under the SAME replica id (restart,
+        # duplicate deploy) claims epoch 2 — the original is fenced on
+        # its next heartbeat and stays fenced (sticky)
+        twin = self._sup(tmp_path, clk, "a")
+        assert twin.epoch == 2
+        assert a.heartbeat() is False
+        assert a.fenced() is True
+        assert twin.heartbeat() is True
+        assert epoch_fence_check(a) is False
+        assert epoch_fence_check(twin) is True
+        assert epoch_fence_check(None) is True
+
+    def test_stale_lease_adopted_and_chain_gced(self, tmp_path):
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        seen = []
+        b.on_adopt = seen.append
+        assert b.poll() == []  # first sight of a's (epoch, stamp)
+        clk.advance(4.0)
+        a.heartbeat()
+        assert b.poll() == []  # stamp moved: staleness clock resets
+        clk.advance(5.1)
+        adoptions = b.poll()
+        assert len(adoptions) == 1
+        adoption = adoptions[0]
+        assert adoption.replica == "a"
+        assert adoption.epoch == 2
+        assert adoption.journal_dir == a.journal_dir
+        assert adoption.stale_for_s > 5.0
+        assert seen == [adoption]
+        # the dead replica is fenced the moment it comes back
+        assert a.heartbeat() is False
+        assert epoch_fence_check(a) is False
+        # chain GC: only the adopted top remains for chain a
+        storage = b._storage
+        a_keys = [
+            k for k in storage.list_keys("leases/lease-a-")
+            if json.loads(storage.read_bytes(k))["replica"] == "a"
+        ]
+        assert a_keys == [_lease_key("a", 2)]
+        top = json.loads(storage.read_bytes(_lease_key("a", 2)))
+        assert top["state"] == "adopted"
+        assert top["owner"] == "b"
+        # an adopted chain is terminal: later polls skip it
+        clk.advance(60.0)
+        assert b.poll() == []
+
+    def test_retired_chain_is_never_adopted(self, tmp_path):
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        b.poll()
+        a.stop(retire=True)
+        clk.advance(60.0)
+        assert b.poll() == []
+
+    def test_exactly_one_adopter_wins_the_cas_race(self, tmp_path):
+        """Two survivors observe the same expired lease concurrently
+        (both read the chain before either claim lands): both compute
+        the same next epoch key, the storage CAS admits exactly one."""
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        c = self._sup(tmp_path, clk, "c")
+        b.poll(), c.poll()
+        clk.advance(5.1)
+        c.heartbeat()  # c is alive — only a's lease goes stale
+        races_before = _counter("service.fleet.adoption_races_lost")
+        assert [ad.replica for ad in b.poll()] == ["a"]
+        # c acts on its STALE read of a's epoch-1 lease — the TOCTOU
+        # window poll() can't reproduce once the blob says "adopted"
+        stale = Lease(
+            replica="a", epoch=1, stamp=0, owner="a",
+            journal_dir=a.journal_dir,
+        )
+        assert c._try_adopt(stale, stale_for_s=5.1) is None
+        assert c.snapshot()["adoption_races_lost"] == 1
+        assert (
+            _counter("service.fleet.adoption_races_lost")
+            - races_before
+            == 1
+        )
+        assert b.snapshot()["adoptions"][0]["replica"] == "a"
+        assert c.snapshot()["adoptions"] == []
+
+    def test_chain_id_prefix_collision_is_harmless(self, tmp_path):
+        """Replica ids where one is a prefix of another ("a" and
+        "a-b") share a listing prefix; chain ops must trust the blob's
+        replica field, not the key."""
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        ab = self._sup(tmp_path, clk, "a-b")
+        assert a.heartbeat() is True  # a-b's chain must not fence a
+        assert ab.heartbeat() is True
+        assert a.epoch == 1 and ab.epoch == 1
+        w = self._sup(tmp_path, clk, "w")
+        assert set(w.snapshot()["peers"]) == {"a", "a-b"}
+
+    def test_poison_ledger_quarantines_at_distinct_replicas(
+        self, tmp_path
+    ):
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a", poison_replicas=2)
+        b = self._sup(tmp_path, clk, "b", poison_replicas=2)
+        key = "dataset:poison-plan"
+        assert a.note_crash_loop(key) == 1
+        assert a.note_crash_loop(key) == 1  # same replica: no growth
+        assert not a.quarantined(key)
+        assert b.note_crash_loop(key) == 2
+        assert a.quarantined(key) and b.quarantined(key)
+        assert a.crashed_replicas(key) == ["a", "b"]
+        assert not a.quarantined("dataset:other")
+
+    def test_fenced_supervisor_never_polls_or_adopts(self, tmp_path):
+        """A fenced replica must stand down from the WATCH side too:
+        a zombie winning an adoption CAS only to drop the replay at
+        the service's fence check would strand the orphan's runs
+        behind a terminal claim."""
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        b.poll()  # sight a's (epoch, stamp)
+        self._sup(tmp_path, clk, "b")  # twin claims b's epoch 2
+        assert b.heartbeat() is False
+        clk.advance(5.1)
+        assert b.poll() == []  # a is stale, but b never watches
+        # even a direct claim attempt stands down before the CAS
+        stale = Lease(
+            replica="a", epoch=1, stamp=0, owner="a",
+            journal_dir=a.journal_dir,
+        )
+        assert b._try_adopt(stale, stale_for_s=5.1) is None
+        # a's chain is untouched: still live, no epoch-2 claim
+        top = json.loads(b._storage.read_bytes(_lease_key("a", 1)))
+        assert top["state"] == "live"
+        assert b._storage.read_bytes(_lease_key("a", 2)) is None
+
+    def test_released_claim_leaves_chain_adoptable(self, tmp_path):
+        """A replica fenced between the CAS win and the replay hands
+        the claim back (release_claim): the chain's previous epoch is
+        the top again and a live survivor's normal staleness watch
+        adopts it — no runs stranded behind a claim nobody replays."""
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        c = self._sup(tmp_path, clk, "c")
+        b.on_adopt = lambda ad: b.release_claim(ad.replica, ad.epoch)
+        b.poll(), c.poll()
+        clk.advance(5.1)
+        c.heartbeat()  # c is alive — only a's lease goes stale to b
+        assert b.poll() == []  # won the CAS, then handed the claim back
+        assert b.snapshot()["adoptions"] == []
+        # the claim blob is gone and the stale live epoch is the top
+        # again (release must run BEFORE chain GC or nothing remains)
+        assert b._storage.read_bytes(_lease_key("a", 2)) is None
+        top = json.loads(b._storage.read_bytes(_lease_key("a", 1)))
+        assert top["state"] == "live"
+        # c's own staleness clock on a has also expired: c re-claims
+        # the SAME epoch (the released key) and the adoption completes
+        b.heartbeat()  # b itself is alive — only a is stale to c
+        adoptions = c.poll()
+        assert [ad.replica for ad in adoptions] == ["a"]
+        assert adoptions[0].epoch == 2
+        top = json.loads(c._storage.read_bytes(_lease_key("a", 2)))
+        assert top["state"] == "adopted" and top["owner"] == "c"
+
+    def test_unfenced_verdict_cached_between_heartbeats(self, tmp_path):
+        """fenced() on persist paths must not pay a storage listing
+        per call: the unfenced verdict is cached for one heartbeat
+        interval on the injected clock; heartbeat() always does a real
+        chain read; the sticky fenced flag never reads again."""
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        calls = []
+        real = a._storage.list_keys
+        a._storage.list_keys = lambda p="": (
+            calls.append(p) or real(p)
+        )
+        assert a.fenced() is False  # cached from registration
+        clk.advance(0.5)
+        assert a.fenced() is False  # still inside the heartbeat window
+        assert calls == []
+        clk.advance(0.6)
+        assert a.fenced() is False  # window expired: one real re-read
+        assert len(calls) == 1
+        assert a.fenced() is False  # fresh verdict re-cached
+        assert len(calls) == 1
+        assert a.heartbeat() is True  # heartbeats always really read
+        assert len(calls) == 2
+        self._sup(tmp_path, clk, "a")  # twin fences a
+        assert a.heartbeat() is False
+        reads_when_fenced = len(calls)
+        assert a.fenced() is True  # sticky: no further storage reads
+        assert len(calls) == reads_when_fenced
+
+    def test_child_epoch_guard_round_trip(self, tmp_path, monkeypatch):
+        clk = ManualClock()
+        a = self._sup(tmp_path, clk, "a")
+        b = self._sup(tmp_path, clk, "b")
+        guard_a = a.child_guard()
+        monkeypatch.delenv(CHILD_EPOCH_ENV, raising=False)
+        assert child_epoch_fenced() is False  # no guard: stay open
+        monkeypatch.setenv(CHILD_EPOCH_ENV, guard_a)
+        assert child_epoch_fenced() is False  # a still owns epoch 1
+        b.poll()
+        clk.advance(5.1)
+        assert len(b.poll()) == 1  # b adopts a's chain at epoch 2
+        assert child_epoch_fenced() is True  # a's child is now fenced
+        monkeypatch.setenv(CHILD_EPOCH_ENV, b.child_guard())
+        assert child_epoch_fenced() is False  # b's own child stays open
+        monkeypatch.setenv(CHILD_EPOCH_ENV, "not json")
+        assert child_epoch_fenced() is False  # torn guard: stay open
+
+
+# --------------------------------------------------------------------------
+# In-process two-replica services: adoption + zombie fencing
+# --------------------------------------------------------------------------
+
+
+class TestServiceFleetFencing:
+    def _request(self, dataset_key="shared"):
+        return RunRequest(
+            tenant="acme",
+            checks=(),
+            dataset_key=dataset_key,
+            dataset_factory=lambda: None,
+            priority=Priority.STANDARD,
+        )
+
+    def test_zombie_replica_drops_all_persists(self, tmp_path):
+        """svc_a pauses (never started: a stand-in for a GC pause or
+        partition), svc_b adopts its journal. The revived svc_a must
+        (1) refuse new admissions with FencedReplica, (2) add ZERO
+        bytes to any journal, (3) never reach a repository save."""
+        clk = ManualClock()
+        fleet_dir = str(tmp_path / "fleet")
+        ja, jb = str(tmp_path / "ja"), str(tmp_path / "jb")
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+        ):
+            svc_a = VerificationService(
+                workers=1, isolated=False, journal_dir=ja,
+                fleet_dir=fleet_dir, replica_id="a",
+                clock=clk, execute=lambda t: _FakeResult(),
+            )
+            svc_b = VerificationService(
+                workers=1, isolated=False, journal_dir=jb,
+                fleet_dir=fleet_dir, replica_id="b",
+                clock=clk, execute=lambda t: _FakeResult(),
+                adopt_resolve=lambda entry: self._request(
+                    entry["dataset_key"]
+                ),
+            )
+        ha = svc_a.submit(self._request("ds-one"))
+        svc_a.submit(self._request("ds-two"))
+        assert len(RunJournal(ja).pending_runs()) == 2
+
+        adopted_before = _counter("service.fleet.runs_adopted")
+        assert svc_b.fleet.poll() == []
+        clk.advance(5.1)
+        assert len(svc_b.fleet.poll()) == 1
+        # both pending runs re-admitted in b, exactly once
+        assert len(svc_b.adopted_runs()) == 2
+        assert (
+            _counter("service.fleet.runs_adopted") - adopted_before == 2
+        )
+        entries = RunJournal(jb).pending_runs()
+        assert sorted(e["adopted_from"] for e in entries.values()) == [
+            "run-1", "run-2"
+        ]
+        assert all(
+            e["adopted_replica"] == "a" for e in entries.values()
+        )
+        # the orphan journal is all-terminal and compacted
+        assert RunJournal(ja).pending_runs() == {}
+
+        # (1) zombie admission refused
+        fenced_before = _counter("service.fleet.fenced_writes")
+        with pytest.raises(FencedReplica):
+            svc_a.submit(self._request("ds-three"))
+        # (2) zombie journal writes are dropped bit-for-bit: no file
+        # in the journal dir grows or appears
+        def _ledger(root):
+            return sorted(
+                (f, os.path.getsize(os.path.join(root, f)))
+                for f in os.listdir(root)
+                if os.path.isfile(os.path.join(root, f))
+            )
+        before = _ledger(ja)
+        ha._state = RunState.DONE
+        svc_a._journal_terminal(ha)
+        assert _ledger(ja) == before
+        # (3) repository saves are dropped before touching the repo
+        class _Repo:
+            calls = 0
+            def save(self, *a, **kw):
+                self.calls += 1
+        repo = _Repo()
+        service_module._persist_member_result(
+            repo, None, None, slo=None, fleet=svc_a.fleet
+        )
+        service_module._persist_slo_records(
+            repo, None, None, fleet=svc_a.fleet
+        )
+        assert repo.calls == 0
+        assert _counter("service.fleet.fenced_writes") > fenced_before
+        # every dropped write is visible on the health plane
+        assert svc_a.health()["fleet"]["fenced"] is True
+        assert svc_b.health()["fleet"]["fenced"] is False
+
+    def test_quarantined_plan_not_readopted(self, tmp_path):
+        """A plan key that crash-looped poison_replicas DISTINCT
+        replicas is refused at adoption and failed terminally in the
+        orphan journal instead of walking the fleet."""
+        clk = ManualClock()
+        fleet_dir = str(tmp_path / "fleet")
+        ja, jb = str(tmp_path / "ja"), str(tmp_path / "jb")
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+            service_fleet_poison_replicas=2,
+        ):
+            svc_a = VerificationService(
+                workers=1, isolated=False, journal_dir=ja,
+                fleet_dir=fleet_dir, replica_id="a",
+                clock=clk, execute=lambda t: _FakeResult(),
+            )
+            svc_b = VerificationService(
+                workers=1, isolated=False, journal_dir=jb,
+                fleet_dir=fleet_dir, replica_id="b",
+                clock=clk, execute=lambda t: _FakeResult(),
+                adopt_resolve=lambda entry: self._request(
+                    entry["dataset_key"]
+                ),
+            )
+        svc_a.submit(self._request("poison"))
+        svc_a.fleet.note_crash_loop("dataset:poison")
+        svc_b.fleet.note_crash_loop("dataset:poison")
+        poisoned_before = _counter("service.fleet.poisoned_runs")
+        svc_b.fleet.poll()
+        clk.advance(5.1)
+        assert len(svc_b.fleet.poll()) == 1
+        assert svc_b.adopted_runs() == []
+        assert (
+            _counter("service.fleet.poisoned_runs") - poisoned_before
+            == 1
+        )
+        assert RunJournal(ja).pending_runs() == {}
+
+
+# --------------------------------------------------------------------------
+# Write-ahead adoption intents: the double-failure recovery road
+# --------------------------------------------------------------------------
+
+
+class TestAdoptionIntentRecovery:
+    def _request(self, dataset_key="shared"):
+        return RunRequest(
+            tenant="acme",
+            checks=(),
+            dataset_key=dataset_key,
+            dataset_factory=lambda: None,
+            priority=Priority.STANDARD,
+        )
+
+    def _service(self, journal_dir, fleet_dir, replica, clk):
+        return VerificationService(
+            workers=1, isolated=False, journal_dir=journal_dir,
+            fleet_dir=fleet_dir, replica_id=replica,
+            clock=clk, execute=lambda t: _FakeResult(),
+            adopt_resolve=lambda entry: self._request(
+                entry["dataset_key"]
+            ),
+        )
+
+    def test_adopter_crash_after_claim_finished_by_its_adopter(
+        self, tmp_path
+    ):
+        """THE run-loss window the intent machinery closes: replica b
+        wins the claim CAS on dead a's chain but dies before
+        journaling any of a's runs. The claim is terminal — nothing
+        ever re-polls it — but b's write-ahead adoption intent
+        survives in b's journal, so whoever adopts b finishes the
+        half-done adoption: a's runs land in c, runs_lost == 0 across
+        the DOUBLE failure."""
+        clk = ManualClock()
+        fleet_dir = str(tmp_path / "fleet")
+        ja, jb, jc = (
+            str(tmp_path / d) for d in ("ja", "jb", "jc")
+        )
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+        ):
+            svc_a = self._service(ja, fleet_dir, "a", clk)
+            svc_b = self._service(jb, fleet_dir, "b", clk)
+            svc_c = self._service(jc, fleet_dir, "c", clk)
+        svc_a.submit(self._request("ds-one"))
+        svc_a.submit(self._request("ds-two"))
+        assert len(RunJournal(ja).pending_runs()) == 2
+
+        # b "crashes" between winning the claim CAS and the replay:
+        # the intent has landed durably (on_adopt_intent fires before
+        # the CAS), the replay callback never runs
+        def _die_mid_adoption(adoption):
+            raise RuntimeError("adopter crashed before the replay")
+
+        svc_b.fleet.on_adopt = _die_mid_adoption
+        svc_b.fleet.poll(), svc_c.fleet.poll()  # sight the peers
+        clk.advance(5.1)
+        svc_c.fleet.heartbeat()  # c stays live while b claims a
+        with pytest.raises(RuntimeError):
+            svc_b.fleet.poll()
+        # the crash left: a's chain terminally claimed, zero runs
+        # moved, and b's journal holding the unfinished intent
+        top = json.loads(
+            svc_c.fleet._storage.read_bytes(_lease_key("a", 2))
+        )
+        assert top["state"] == "adopted" and top["owner"] == "b"
+        assert svc_b.adopted_runs() == []
+        (intent,) = RunJournal(jb).pending_adoptions()
+        assert (intent["replica"], intent["epoch"]) == ("a", 2)
+        assert intent["journal_dir"] == ja
+
+        # b now dies for real (stops heartbeating); c adopts b's
+        # chain, finds the pending intent, and finishes the adoption
+        # by re-claiming a's chain at the NEXT epoch
+        finished_before = _counter("service.fleet.adoptions_finished")
+        clk.advance(5.1)
+        adoptions = svc_c.fleet.poll()
+        assert [ad.replica for ad in adoptions] == ["b"]
+        assert (
+            _counter("service.fleet.adoptions_finished")
+            - finished_before
+            == 1
+        )
+        # a's two runs landed in c — exactly once, nothing lost
+        assert len(svc_c.adopted_runs()) == 2
+        entries = RunJournal(jc).pending_runs()
+        assert sorted(
+            e["adopted_from"] for e in entries.values()
+        ) == ["run-1", "run-2"]
+        assert all(
+            e["adopted_replica"] == "a" for e in entries.values()
+        )
+        # the finisher claimed epoch 3 on a's chain (CAS-unique even
+        # on a terminal chain)
+        top = json.loads(
+            svc_c.fleet._storage.read_bytes(_lease_key("a", 3))
+        )
+        assert top["state"] == "adopted" and top["owner"] == "c"
+        # every journal is clean: a all-terminal, b's intent closed by
+        # the finisher, c's own intents bracketed and compacted
+        assert RunJournal(ja).pending_runs() == {}
+        assert RunJournal(jb).pending_adoptions() == []
+        assert RunJournal(jc).pending_adoptions() == []
+        # the zombie b stays fenced out
+        assert svc_b.fleet.heartbeat() is False
+
+    def test_lost_claim_race_closes_the_intent(self, tmp_path):
+        """An intent whose claim CAS LOSES must be closed (status
+        race_lost) — otherwise every later adopter of this journal
+        would replay a race this replica never won."""
+        clk = ManualClock()
+        fleet_dir = str(tmp_path / "fleet")
+        ja, jb, jc = (
+            str(tmp_path / d) for d in ("ja", "jb", "jc")
+        )
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+        ):
+            svc_a = self._service(ja, fleet_dir, "a", clk)
+            svc_b = self._service(jb, fleet_dir, "b", clk)
+            svc_c = self._service(jc, fleet_dir, "c", clk)
+        svc_a.submit(self._request("ds-one"))
+        svc_b.fleet.poll(), svc_c.fleet.poll()
+        clk.advance(5.1)
+        svc_b.fleet.heartbeat(), svc_c.fleet.heartbeat()
+        assert len(svc_b.fleet.poll()) == 1  # b wins the adoption
+        # c acts on its stale read of a's epoch-1 lease and loses
+        stale = Lease(
+            replica="a", epoch=1, stamp=0, owner="a", journal_dir=ja,
+        )
+        assert svc_c.fleet._try_adopt(stale, stale_for_s=5.1) is None
+        # c's journal holds the full bracket: intent + race_lost done
+        records = [
+            (r["type"], r.get("status"))
+            for r in RunJournal(jc).replay()
+            if r["type"].startswith("adoption_")
+        ]
+        assert records == [
+            ("adoption_intent", None), ("adoption_done", "race_lost"),
+        ]
+        assert RunJournal(jc).pending_adoptions() == []
+        # and a recover() of c replays nothing for it
+        assert svc_c.recover() == []
+
+    def test_pending_adoptions_bracket_and_compaction(self, tmp_path):
+        """Journal semantics under the intents: an intent with no done
+        record stays pending across compaction (it is a crash's only
+        road back); a matched intent/done pair is dead weight and
+        compacts away; run records are untouched by either."""
+        j = RunJournal(str(tmp_path / "j"))
+        j.record_submitted("run-1", tenant="acme", dataset_key="ds")
+        j.record_adoption_intent("a", "/ja", 2)
+        j.record_adoption_intent("x", "/jx", 5)
+        j.record_adoption_done("x", 5, status="race_lost")
+        pend = j.pending_adoptions()
+        assert [(p["replica"], p["epoch"]) for p in pend] == [("a", 2)]
+        assert pend[0]["journal_dir"] == "/ja"
+        j.compact()
+        # the pending intent and the live run both survived; the
+        # matched (x, 5) bracket is gone
+        pend = j.pending_adoptions()
+        assert [(p["replica"], p["epoch"]) for p in pend] == [("a", 2)]
+        assert set(j.pending_runs()) == {"run-1"}
+        assert not any(
+            r.get("replica") == "x" for r in j.replay()
+        )
+        # closing the intent makes the whole bracket compactable
+        j.record_adoption_done("a", 2, status="adopted")
+        j.compact()
+        assert j.pending_adoptions() == []
+        assert not any(
+            r["type"].startswith("adoption_") for r in j.replay()
+        )
+        assert set(j.pending_runs()) == {"run-1"}
+
+    def test_restarted_replica_finishes_its_own_intent(self, tmp_path):
+        """The same half-done adoption healed WITHOUT a third replica:
+        the crashed adopter restarts, re-registers a fresh epoch, and
+        recover() walks its own pending intents."""
+        clk = ManualClock()
+        fleet_dir = str(tmp_path / "fleet")
+        ja, jb = str(tmp_path / "ja"), str(tmp_path / "jb")
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+        ):
+            svc_a = self._service(ja, fleet_dir, "a", clk)
+            svc_b = self._service(jb, fleet_dir, "b", clk)
+        svc_a.submit(self._request("ds-one"))
+        svc_b.fleet.on_adopt = lambda ad: (_ for _ in ()).throw(
+            RuntimeError("crash before replay")
+        )
+        svc_b.fleet.poll()
+        clk.advance(5.1)
+        with pytest.raises(RuntimeError):
+            svc_b.fleet.poll()
+        assert len(RunJournal(jb).pending_adoptions()) == 1
+        # b restarts: same journal dir, fresh supervisor epoch
+        with config.configure(
+            service_fleet_heartbeat_s=1.0,
+            service_fleet_lease_timeout_s=5.0,
+        ):
+            svc_b2 = self._service(jb, fleet_dir, "b", clk)
+        recovered = svc_b2.recover()
+        assert len(svc_b2.adopted_runs()) == 1
+        del recovered  # a's run arrives via adoption, not recovery
+        entries = RunJournal(jb).pending_runs()
+        assert sorted(
+            e.get("adopted_from") for e in entries.values()
+        ) == ["run-1"]
+        assert RunJournal(jb).pending_adoptions() == []
+        assert RunJournal(ja).pending_runs() == {}
+
+
+# --------------------------------------------------------------------------
+# Chaos differential: SIGKILL a whole replica, survivor adopts+resumes
+# --------------------------------------------------------------------------
+
+
+def _fleet_victim(payload):
+    """A whole fleet replica that dies by SIGKILL mid-scan: registers
+    its lease, journals one run, and hard-crashes the PROCESS at batch
+    7 — after the submitted/started records and two durable checkpoint
+    cursors (in the SHARED fleet checkpoint dir) have landed."""
+    from deequ_tpu.testing.faults import FaultInjectingDataset
+
+    ds = FaultInjectingDataset(
+        Dataset.from_pydict(payload["data"]),
+        crash_at_batch=7,
+        crash_signum=signal.SIGKILL,
+    )
+    with config.configure(
+        checkpoint_every_batches=3, batch_size=104, device_cache_bytes=0,
+        service_fleet_heartbeat_s=0.2, service_fleet_lease_timeout_s=1.0,
+    ):
+        svc = VerificationService(
+            workers=1, isolated=False,
+            journal_dir=payload["journal_dir"],
+            fleet_dir=payload["fleet_dir"],
+            replica_id="victim",
+        ).start()
+        handle = svc.submit(
+            RunRequest(
+                tenant="acme",
+                checks=_checks(),
+                dataset=ds,
+                priority=Priority.STANDARD,
+            )
+        )
+        handle.wait(timeout=120)  # the SIGKILL lands first
+    return "unreachable"
+
+
+class TestFleetChaosDifferential:
+    def test_sigkilled_replica_adopted_and_resumed_bit_identical(
+        self, tmp_path
+    ):
+        data = _table_data()
+        fleet_dir = str(tmp_path / "fleet")
+        victim_journal = str(tmp_path / "victim-journal")
+        survivor_journal = str(tmp_path / "survivor-journal")
+
+        victim = IsolatedRunner(
+            key="fleet-victim", max_relaunches=1, timeout_s=300.0,
+            use_breaker=False,
+        )
+        with pytest.raises(CrashLoopError) as excinfo:
+            victim.run(
+                _fleet_victim,
+                {
+                    "data": data,
+                    "journal_dir": victim_journal,
+                    "fleet_dir": fleet_dir,
+                },
+            )
+        assert excinfo.value.last_signal == "SIGKILL"
+
+        # the victim's durable traces survived the kill: a live lease,
+        # a pending started run, a checkpoint cursor in the SHARED dir
+        pending = RunJournal(victim_journal).pending_runs()
+        assert len(pending) == 1
+        (orphan_id, entry), = pending.items()
+        assert entry["started"] is True
+        assert entry["last_checkpoint"] is not None
+
+        tm = get_telemetry()
+        resumes_before = tm.counter("engine.resumes").value
+        with config.configure(
+            checkpoint_every_batches=3, batch_size=104,
+            device_cache_bytes=0,
+            service_fleet_heartbeat_s=0.2,
+            service_fleet_lease_timeout_s=1.0,
+        ):
+            oracle = VerificationSuite.do_verification_run(
+                Dataset.from_pydict(data), _checks()
+            )
+            t0 = time.monotonic()
+            svc = VerificationService(
+                workers=1, isolated=False,
+                journal_dir=survivor_journal,
+                fleet_dir=fleet_dir,
+                replica_id="survivor",
+                adopt_resolve=lambda entry: RunRequest(
+                    tenant=entry["tenant"],
+                    checks=_checks(),
+                    dataset=Dataset.from_pydict(data),
+                ),
+            )
+            # hand-driven watch loop: first poll sights the dead lease,
+            # the second — one lease timeout later — must adopt
+            assert svc.fleet.poll() == []
+            time.sleep(1.3)
+            adoptions = svc.fleet.poll()
+            assert len(adoptions) == 1
+            time_to_adoption = time.monotonic() - t0
+            assert adoptions[0].replica == "victim"
+            adopted = svc.adopted_runs()
+            assert len(adopted) == 1  # runs_lost == 0
+            svc.start()
+            try:
+                handle = adopted[0]
+                assert handle.wait(timeout=120)
+                assert handle.status == RunState.DONE
+                result = handle.result(timeout=0)
+            finally:
+                svc.stop(drain=False, timeout=10)
+        # adoption happened within ~one lease timeout + poll cadence,
+        # not after some multi-cycle backoff
+        assert time_to_adoption < 10.0
+        assert adoptions[0].stale_for_s < 10.0
+        # resumed from the DEAD replica's durable cursor (shared fleet
+        # checkpoint dir), not recomputed from scratch
+        assert tm.counter("engine.resumes").value - resumes_before == 1
+        assert result.status == CheckStatus.SUCCESS
+        assert _result_values(result) == _result_values(oracle)
+        # exactly-once: the orphan journal is fully terminal, the
+        # adopter's journal reaches terminal too — no run persisted
+        # twice, none lost
+        assert RunJournal(victim_journal).pending_runs() == {}
+        assert RunJournal(survivor_journal).pending_runs() == {}

@@ -721,6 +721,111 @@ def render_crash_recovery(records: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(records: List[Dict[str, Any]]) -> str:
+    """The ``fleet failover:`` section (docs/SERVICE.md "Fleet
+    failover"): lease membership, expiries and adoptions with their
+    staleness ages, orphan runs re-admitted, zombie writes fenced, and
+    poison quarantines — the fleet-level fault story from one JSONL
+    artifact. Empty string when the artifact has no fleet signals."""
+    counters: Dict[str, float] = {}
+    for r in load_runs(records):
+        for k, v in r.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    events = [r for r in records if r.get("type") == "event"]
+    claimed = [e for e in events if e.get("event") == "fleet_lease_claimed"]
+    retired = [e for e in events if e.get("event") == "fleet_lease_retired"]
+    expired = [e for e in events if e.get("event") == "fleet_lease_expired"]
+    adoptions = [e for e in events if e.get("event") == "fleet_adoption"]
+    races = [
+        e for e in events if e.get("event") == "fleet_adoption_race_lost"
+    ]
+    run_adopted = [
+        e for e in events if e.get("event") == "service_run_adopted"
+    ]
+    fenced = [e for e in events if e.get("event") == "fleet_write_fenced"]
+    poisoned = [e for e in events if e.get("event") == "fleet_run_poisoned"]
+
+    adoption_count = int(counters.get("service.fleet.adoptions", 0)) or len(
+        adoptions
+    )
+    fenced_count = int(
+        counters.get("service.fleet.fenced_writes", 0)
+    ) or len(fenced)
+    poison_count = int(
+        counters.get("service.fleet.poisoned_runs", 0)
+    ) or len(poisoned)
+    if not any(
+        (claimed, retired, expired, adoption_count, fenced_count,
+         poison_count)
+    ):
+        return ""
+
+    lines = ["fleet failover:"]
+    if claimed or retired:
+        members = sorted(
+            {str(e.get("replica", "?")) for e in claimed}
+        )
+        retired_ids = sorted(
+            {str(e.get("replica", "?")) for e in retired}
+        )
+        line = f"  replicas: {len(members)}"
+        if members:
+            line += f" ({', '.join(members)})"
+        if retired_ids:
+            line += f", retired cleanly: {', '.join(retired_ids)}"
+        lines.append(line)
+    for e in expired:
+        lines.append(
+            f"  lease expired: {e.get('replica', '?')}"
+            f" epoch {e.get('epoch', '?')}"
+            f" after {e.get('stale_for_s', '?')}s"
+            f" (observer {e.get('observer', '?')})"
+        )
+    if adoption_count:
+        for e in adoptions:
+            lines.append(
+                f"  adoption: {e.get('adopter', '?')} claimed"
+                f" {e.get('replica', '?')} at epoch"
+                f" {e.get('epoch', '?')}"
+                f" (stale {e.get('stale_for_s', '?')}s)"
+            )
+        if not adoptions:
+            lines.append(f"  adoptions: {adoption_count}")
+    if races:
+        losers = sorted({str(e.get("loser", "?")) for e in races})
+        lines.append(
+            f"  adoption races lost: {len(races)}"
+            f" (losers: {', '.join(losers)})"
+        )
+    runs_count = int(
+        counters.get("service.fleet.runs_adopted", 0)
+    ) or len(run_adopted)
+    if runs_count:
+        resumed = sum(1 for e in run_adopted if e.get("last_checkpoint"))
+        lines.append(
+            f"  orphan runs re-admitted: {runs_count}"
+            f" ({resumed} from a checkpoint cursor)"
+        )
+    if fenced_count:
+        zombies = sorted({str(e.get("replica", "?")) for e in fenced})
+        lines.append(
+            f"  zombie writes fenced: {fenced_count}"
+            + (f" (replicas: {', '.join(zombies)})" if zombies else "")
+        )
+    drops = int(counters.get("service.fleet.child_checkpoint_drops", 0))
+    if drops:
+        lines.append(f"  fenced child checkpoint drops: {drops}")
+    if poison_count:
+        keys = sorted(
+            {str(e.get("plan_key", "?")) for e in poisoned}
+        )
+        lines.append(
+            f"  poison quarantines: {poison_count}"
+            + (f" (plans: {', '.join(keys)})" if keys else "")
+        )
+    return "\n".join(lines)
+
+
 def render_staticcheck(root: Optional[str] = None) -> str:
     """One-line static-analysis health summary, e.g. ``staticcheck: 0
     finding(s), 29 waived across 12 rules (clean)``."""
@@ -768,6 +873,7 @@ def render(
     service_only: bool = False,
     crashes_only: bool = False,
     placement_only: bool = False,
+    fleet_only: bool = False,
 ) -> str:
     if service_only:
         section = render_service(records)
@@ -778,6 +884,9 @@ def render(
     if placement_only:
         section = render_placement(records)
         return section or "no placement signals in artifact"
+    if fleet_only:
+        section = render_fleet(records)
+        return section or "no fleet signals in artifact"
     runs = load_runs(records)
     if run_id is not None:
         runs = [r for r in runs if r.get("run_id") == run_id]
@@ -816,6 +925,9 @@ def render(
         crash_section = render_crash_recovery(records)
         if crash_section:
             body = body + "\n\n" + crash_section
+        fleet_section = render_fleet(records)
+        if fleet_section:
+            body = body + "\n\n" + fleet_section
     return body
 
 
@@ -844,6 +956,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--placement", action="store_true",
         help="print only the elastic device placement section",
+    )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="print only the fleet failover section (leases, "
+        "adoptions, fencing, poison quarantines)",
     )
     parser.add_argument(
         "--staticcheck", action="store_true",
@@ -887,6 +1004,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         service_only=args.service,
         crashes_only=args.crashes,
         placement_only=args.placement,
+        fleet_only=args.fleet,
     ))
     if args.staticcheck:
         print(render_staticcheck())

@@ -28,6 +28,7 @@ from tools.staticcheck.core import (  # noqa: F401
 
 # importing the analyzer modules registers the default suite
 from tools.staticcheck import egressdur as _egressdur  # noqa: F401,E402
+from tools.staticcheck import fence as _fence  # noqa: F401,E402
 from tools.staticcheck import interrupts as _interrupts  # noqa: F401,E402
 from tools.staticcheck import locks as _locks  # noqa: F401,E402
 from tools.staticcheck import metricdocs as _metricdocs  # noqa: F401,E402

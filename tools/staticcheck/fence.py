@@ -1,0 +1,161 @@
+"""Fence-discipline analyzer: no persist call without an epoch fence.
+
+The fencing invariant of fleet failover (docs/SERVICE.md "Fleet
+failover"): once a replica's lease epoch has been superseded by an
+adopter, every journal/repository persist it attempts would corrupt
+state the adopter now owns — a zombie terminal record marks an adopted
+run finished in a journal nobody replays, a zombie repository save
+double-appends a result the adopter also persists. The runtime guard
+is ``epoch_fence_check`` (service/fleet.py), which returns False (and
+counts ``service.fleet.fenced_writes``) for a superseded epoch.
+
+The rule is structural, the house style of ``preempt-discipline``:
+inside ``deequ_tpu/service/``, every call to a journal persist method
+(``record_submitted`` / ``record_started`` / ``record_checkpoint`` /
+``record_preempted`` / ``record_resumed`` / ``record_terminal`` /
+``record_adoption_intent`` / ``record_adoption_done``) or a
+repository ``save`` must be LEXICALLY PRECEDED, within the same
+enclosing function, by a call to ``epoch_fence_check`` — the
+fence -> persist ordering made checkable. Flow-insensitive on purpose:
+the fence is sticky (a superseded epoch is never reclaimed), so any
+earlier check in the function covers every later persist. Method
+DEFINITIONS are exempt by construction (``super().save(...)`` has a
+computed callee and record_* bodies call ``self.append``); sites with
+a structural fence of their own (e.g. a write published by the lease
+CAS itself) carry a ``# lint-ok: fence-discipline: <reason>`` waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tools.staticcheck.core import (
+    Analyzer,
+    Finding,
+    SourceFile,
+    dotted_name,
+    register,
+)
+
+SCOPE_PREFIX = "deequ_tpu/service/"
+
+GUARDED_ATTRS = frozenset(
+    {
+        "record_submitted",
+        "record_started",
+        "record_checkpoint",
+        "record_preempted",
+        "record_resumed",
+        "record_terminal",
+        "record_adoption_intent",
+        "record_adoption_done",
+        "save",
+    }
+)
+EVIDENCE_NAME = "epoch_fence_check"
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """The last path segment of the called name ('save' for
+    ``repository.save(...)``), or None for computed callees."""
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _function_sites(
+    tree: ast.AST,
+) -> Iterable[Tuple[Optional[ast.AST], List[ast.Call]]]:
+    """(enclosing function, calls directly inside it) pairs; calls in
+    nested functions belong to the NESTED function (each scope must
+    establish its own fence), module-level calls to None."""
+    functions = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    owner: dict[int, ast.AST] = {}
+    for fn in functions:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                # innermost function wins: walk visits outer functions
+                # first, so a later (nested) owner overwrites
+                owner[id(node)] = fn
+    by_fn: dict[int, List[ast.Call]] = {}
+    module_level: List[ast.Call] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = owner.get(id(node))
+        if fn is None:
+            module_level.append(node)
+        else:
+            by_fn.setdefault(id(fn), []).append(node)
+    for fn in functions:
+        yield fn, by_fn.get(id(fn), [])
+    if module_level:
+        yield None, module_level
+
+
+class FenceDisciplineAnalyzer(Analyzer):
+    name = "fence"
+    rules = ("fence-discipline",)
+    description = (
+        "journal/repository persist call sites in deequ_tpu/service/ "
+        "not preceded by an epoch fence check"
+    )
+
+    def analyze(
+        self, files: Sequence[SourceFile], root: str
+    ) -> Iterable[Finding]:
+        for sf in files:
+            if not sf.rel.startswith(SCOPE_PREFIX) or sf.tree is None:
+                continue
+            if sf.rel == SCOPE_PREFIX + "journal.py":
+                # the journal module DEFINES the persist vocabulary
+                # (record_* bodies delegate to self.append); it holds
+                # no fleet state and cannot fence itself
+                continue
+            for fn, calls in _function_sites(sf.tree):
+                evidence_lines = [
+                    c.lineno
+                    for c in calls
+                    if _call_name(c) == EVIDENCE_NAME
+                ]
+                first_evidence = (
+                    min(evidence_lines) if evidence_lines else None
+                )
+                for call in calls:
+                    attr = _call_name(call)
+                    if attr not in GUARDED_ATTRS:
+                        continue
+                    if not isinstance(call.func, ast.Attribute):
+                        continue  # a local helper, not a persist target
+                    if (
+                        first_evidence is not None
+                        and first_evidence < call.lineno
+                    ):
+                        continue
+                    where = (
+                        f"function {getattr(fn, 'name', '?')!r}"
+                        if fn is not None
+                        else "module level"
+                    )
+                    yield Finding(
+                        rule="fence-discipline",
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            f".{attr}() at {where} without a preceding "
+                            f"{EVIDENCE_NAME}() call — a persist is "
+                            "only licensed while this replica still "
+                            "owns its lease epoch (docs/SERVICE.md "
+                            '"Fleet failover")'
+                        ),
+                        symbol=attr,
+                    )
+
+
+register(FenceDisciplineAnalyzer())
